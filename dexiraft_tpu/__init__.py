@@ -1,0 +1,21 @@
+"""dexiraft_tpu — a TPU-native optical-flow framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design with the capabilities of the
+Dexi-RAFT reference (RAFT optical flow fused with DexiNed edge detection):
+all-pairs 4D correlation volumes, iterative ConvGRU refinement, dual
+image/edge streams, the full Chairs->Things->Sintel->KITTI curriculum,
+and data-parallel scaling over TPU device meshes.
+
+Layout (bottom-up, mirroring the reference's layer map, SURVEY.md §1):
+  ops/       pure-function building blocks (sampling, correlation, upsample, losses)
+  models/    flax modules (encoders, update blocks, DexiNed, RAFT variants)
+  data/      host-side dataset pipeline (flow file I/O, augmentors, curriculum)
+  parallel/  device meshes, sharding rules, collective helpers
+  train/     jitted train step, optimizer/schedule, checkpointing, logging
+  evaluation/ validators and benchmark-submission writers
+  utils/     padding, flow visualization, warm-start interpolation
+
+All arrays are NHWC (channel-last), the natural TPU layout.
+"""
+
+__version__ = "0.1.0"

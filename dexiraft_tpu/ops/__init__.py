@@ -1,0 +1,34 @@
+"""Pure-function ops: sampling, correlation, upsampling, losses.
+
+Every op here is shape-polymorphic, jit-safe (static shapes only), and has
+a parity test against the reference semantics in tests/.
+"""
+
+from dexiraft_tpu.ops.grid import (
+    bilinear_sampler,
+    coords_grid,
+    resize_bilinear_align_corners,
+    upflow8,
+)
+from dexiraft_tpu.ops.corr import (
+    all_pairs_correlation,
+    build_corr_pyramid,
+    corr_lookup,
+    CorrPyramid,
+)
+from dexiraft_tpu.ops.upsample import upsample_flow_convex
+from dexiraft_tpu.ops.losses import sequence_loss, flow_metrics
+
+__all__ = [
+    "bilinear_sampler",
+    "coords_grid",
+    "resize_bilinear_align_corners",
+    "upflow8",
+    "all_pairs_correlation",
+    "build_corr_pyramid",
+    "corr_lookup",
+    "CorrPyramid",
+    "upsample_flow_convex",
+    "sequence_loss",
+    "flow_metrics",
+]

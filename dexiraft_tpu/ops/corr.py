@@ -1,0 +1,131 @@
+"""All-pairs 4D correlation volume: build, pyramid, windowed lookup.
+
+TPU-native re-design of the reference centerpiece (core/corr.py:12-60):
+the volume is one big batched matmul (MXU-friendly), the pyramid is
+reduce_window average pooling, and the per-iteration lookup gathers a
+(2r+1)^2 bilinear window per pixel per level.
+
+Layouts: feature maps are (B, H, W, D); the flattened volume is
+(B*H*W, H_l, W_l, 1) per level — same flattening the reference uses so the
+lookup is a plain batched 2D sample.
+
+This module is the materialized path; the memory-efficient on-demand
+equivalent of the reference's alt_cuda_corr CUDA kernel
+(alt_cuda_corr/correlation_kernel.cu) is a separate op
+(see dexiraft_tpu.ops.local_corr once built).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.ops.grid import bilinear_sampler
+
+
+@flax.struct.dataclass
+class CorrPyramid:
+    """Correlation pyramid + lookup geometry.
+
+    A pytree whose leaves are only the level arrays; the geometry ints are
+    static aux data, so instances are safe to pass through jit boundaries
+    and lax.scan carries without tracer leakage into shape arithmetic.
+    """
+
+    levels: tuple  # tuple of (B*H*W, H_l, W_l, 1) arrays
+    batch: int = flax.struct.field(pytree_node=False)
+    ht: int = flax.struct.field(pytree_node=False)
+    wd: int = flax.struct.field(pytree_node=False)
+    radius: int = flax.struct.field(pytree_node=False)
+
+    def __call__(self, coords: jax.Array) -> jax.Array:
+        return corr_lookup(self, coords)
+
+
+def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
+    """corr[b, i, j, k, l] = <fmap1[b,i,j,:], fmap2[b,k,l,:]> / sqrt(D).
+
+    fmap1, fmap2: (B, H, W, D). Returns (B*H*W, H, W, 1) in float32 —
+    the flattened layout the pyramid/lookup consume.
+    Reference: core/corr.py:52-60 (matmul + /sqrt(dim)), fp32 like
+    core/raft.py:139-142.
+    """
+    b, h, w, d = fmap1.shape
+    f1 = fmap1.reshape(b, h * w, d).astype(jnp.float32)
+    f2 = fmap2.reshape(b, h * w, d).astype(jnp.float32)
+    corr = jnp.einsum("bnd,bmd->bnm", f1, f2, preferred_element_type=jnp.float32)
+    corr = corr / jnp.sqrt(jnp.float32(d))
+    return corr.reshape(b * h * w, h, w, 1)
+
+
+def avg_pool_2x2(x: jax.Array) -> jax.Array:
+    """2x2 stride-2 average pool over the spatial dims of (N, H, W, C).
+
+    VALID padding so odd trailing rows/cols are dropped — exactly
+    torch.nn.functional.avg_pool2d(x, 2, stride=2) (core/corr.py:26).
+
+    Implemented as slice + reshape + mean rather than lax.reduce_window:
+    identical numerics, cleanly differentiable in reverse mode (reduce_window
+    linearization is unsupported on some backends), and XLA lowers it to the
+    same windowed reduction.
+    """
+    n, h, w, c = x.shape
+    h2, w2 = h // 2, w // 2
+    x = x[:, : 2 * h2, : 2 * w2, :]
+    x = x.reshape(n, h2, 2, w2, 2, c)
+    return x.mean(axis=(2, 4))
+
+
+def build_corr_pyramid(
+    fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4, radius: int = 4
+) -> CorrPyramid:
+    """Materialize the all-pairs volume and its average-pool pyramid.
+
+    Reference: core/corr.py:13-27. Level i has shape
+    (B*H*W, H >> i, W >> i, 1) (floor division via VALID pooling).
+    """
+    b, h, w, _ = fmap1.shape
+    corr = all_pairs_correlation(fmap1, fmap2)
+    levels: List[jax.Array] = [corr]
+    for _ in range(num_levels - 1):
+        corr = avg_pool_2x2(corr)
+        levels.append(corr)
+    return CorrPyramid(levels=tuple(levels), batch=b, ht=h, wd=w, radius=radius)
+
+
+def _window_delta(radius: int, dtype=jnp.float32) -> jax.Array:
+    """(2r+1, 2r+1, 2) integer offset lattice, channels (dx, dy).
+
+    The reference builds its lattice with meshgrid(dy, dx) (core/corr.py:37-43)
+    which transposes the window axes; since the window is a symmetric square
+    feeding learned layers, only internal consistency matters — we use the
+    natural orientation (x varies along axis 1).
+    """
+    d = jnp.arange(-radius, radius + 1, dtype=dtype)
+    dyy, dxx = jnp.meshgrid(d, d, indexing="ij")
+    return jnp.stack([dxx, dyy], axis=-1)
+
+
+def corr_lookup(pyramid: CorrPyramid, coords: jax.Array) -> jax.Array:
+    """Sample a (2r+1)^2 window around ``coords / 2^i`` at every level.
+
+    coords: (B, H, W, 2) current correspondence estimates in level-0 pixels.
+    Returns (B, H, W, num_levels * (2r+1)^2) float32 correlation features.
+    Reference: core/corr.py:29-50.
+    """
+    r = pyramid.radius
+    b, h, w = pyramid.batch, pyramid.ht, pyramid.wd
+    win = 2 * r + 1
+    delta = _window_delta(r, dtype=coords.dtype)  # (win, win, 2)
+
+    flat = coords.reshape(b * h * w, 1, 1, 2)
+    out = []
+    for i, corr in enumerate(pyramid.levels):
+        centroid = flat / (2.0**i)
+        coords_lvl = centroid + delta[None]  # (BHW, win, win, 2)
+        sampled = bilinear_sampler(corr, coords_lvl)  # (BHW, win, win, 1)
+        out.append(sampled.reshape(b, h, w, win * win))
+    return jnp.concatenate(out, axis=-1).astype(jnp.float32)

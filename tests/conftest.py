@@ -1,0 +1,18 @@
+"""Test configuration: force CPU with 8 virtual devices.
+
+Multi-chip sharding logic is exercised on a virtual CPU mesh (no TPU
+needed). The environment pins JAX_PLATFORMS=axon (the TPU tunnel) via a
+site hook, so setting the env var alone is not enough — we also update the
+jax config after import, before any computation runs.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,106 @@
+"""Parity tests for the all-pairs correlation volume against the reference
+CorrBlock semantics (core/corr.py:12-60), re-implemented here in torch.
+"""
+
+import numpy as np
+import pytest
+
+from dexiraft_tpu.ops import build_corr_pyramid, corr_lookup
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+
+class TorchCorrBlock:
+    """Reference CorrBlock (core/corr.py), with the natural (dx, dy) window
+    orientation used by our implementation (the reference's meshgrid(dy, dx)
+    transposes the window — a learned-layer-internal permutation, see
+    dexiraft_tpu/ops/corr.py:_window_delta)."""
+
+    def __init__(self, fmap1, fmap2, num_levels=4, radius=4):
+        self.num_levels = num_levels
+        self.radius = radius
+        b, dim, h, w = fmap1.shape
+        f1 = fmap1.view(b, dim, h * w)
+        f2 = fmap2.view(b, dim, h * w)
+        corr = torch.matmul(f1.transpose(1, 2), f2) / (dim**0.5)
+        corr = corr.view(b * h * w, 1, h, w)
+        self.batch, self.h, self.w = b, h, w
+        self.pyramid = [corr]
+        for _ in range(num_levels - 1):
+            corr = F.avg_pool2d(corr, 2, stride=2)
+            self.pyramid.append(corr)
+
+    def __call__(self, coords):  # coords (B, 2, H, W), channels (x, y)
+        r = self.radius
+        coords = coords.permute(0, 2, 3, 1)
+        b, h, w, _ = coords.shape
+        out = []
+        for i, corr in enumerate(self.pyramid):
+            d = torch.linspace(-r, r, 2 * r + 1)
+            dyy, dxx = torch.meshgrid(d, d, indexing="ij")
+            delta = torch.stack([dxx, dyy], dim=-1)  # (win, win, 2) as (dx, dy)
+            centroid = coords.reshape(b * h * w, 1, 1, 2) / 2**i
+            coords_lvl = centroid + delta.view(1, 2 * r + 1, 2 * r + 1, 2)
+
+            H, W = corr.shape[-2:]
+            xg, yg = coords_lvl.split([1, 1], dim=-1)
+            xg = 2 * xg / (W - 1) - 1
+            yg = 2 * yg / (H - 1) - 1
+            sampled = F.grid_sample(
+                corr, torch.cat([xg, yg], dim=-1), align_corners=True
+            )
+            out.append(sampled.view(b, h, w, -1))
+        return torch.cat(out, dim=-1)
+
+
+@pytest.mark.parametrize("radius,num_levels", [(4, 4), (3, 4), (2, 2)])
+def test_corr_pyramid_and_lookup_match_torch(radius, num_levels):
+    rng = np.random.RandomState(0)
+    # keep every pyramid level >= 2 in both dims: torch's grid normalization
+    # divides by (size-1) and NaNs out on singleton levels
+    B, H, W, D = 2, 16, 24, 8
+    f1 = rng.randn(B, H, W, D).astype(np.float32)
+    f2 = rng.randn(B, H, W, D).astype(np.float32)
+    coords = (
+        np.stack(np.meshgrid(np.arange(W), np.arange(H)), axis=-1)[None]
+        .repeat(B, axis=0)
+        .astype(np.float32)
+    )
+    coords += rng.uniform(-2, 2, coords.shape).astype(np.float32)
+
+    pyr = build_corr_pyramid(f1, f2, num_levels=num_levels, radius=radius)
+    ours = np.asarray(corr_lookup(pyr, coords))
+
+    tb = TorchCorrBlock(
+        torch.from_numpy(f1.transpose(0, 3, 1, 2)),
+        torch.from_numpy(f2.transpose(0, 3, 1, 2)),
+        num_levels=num_levels,
+        radius=radius,
+    )
+    ref = tb(torch.from_numpy(coords.transpose(0, 3, 1, 2))).numpy()
+
+    assert ours.shape == (B, H, W, num_levels * (2 * radius + 1) ** 2)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pyramid_shapes_floor_division():
+    # odd spatial dims must floor like avg_pool2d (e.g. Sintel 55x128 at 1/8)
+    rng = np.random.RandomState(1)
+    f = rng.randn(1, 55, 13, 4).astype(np.float32)
+    pyr = build_corr_pyramid(f, f, num_levels=4, radius=4)
+    shapes = [lvl.shape[1:3] for lvl in pyr.levels]
+    assert shapes == [(55, 13), (27, 6), (13, 3), (6, 1)]
+
+
+def test_corr_pyramid_is_jit_safe_pytree():
+    """Geometry ints are static aux data — jit/scan must not trace them."""
+    import jax
+
+    from dexiraft_tpu.ops import coords_grid
+
+    rng = np.random.RandomState(2)
+    f = rng.randn(1, 16, 16, 8).astype(np.float32)
+    pyr = build_corr_pyramid(f, f)
+    out = jax.jit(corr_lookup)(pyr, coords_grid(1, 16, 16))
+    assert out.shape == (1, 16, 16, 324)

@@ -1,0 +1,90 @@
+"""Parity tests for coordinate grids and bilinear sampling.
+
+The bilinear sampler is parity-critical (SURVEY.md §7 hard part #2): it must
+match torch grid_sample(align_corners=True, padding_mode='zeros') exactly,
+because the correlation lookup and therefore EPE parity depend on it.
+"""
+
+import numpy as np
+import pytest
+
+from dexiraft_tpu.ops import (
+    bilinear_sampler,
+    coords_grid,
+    resize_bilinear_align_corners,
+    upflow8,
+)
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+
+def torch_bilinear_sampler(img_nchw, coords_xy):
+    """The reference wrapper (core/utils/utils.py:57-71)."""
+    H, W = img_nchw.shape[-2:]
+    xgrid, ygrid = coords_xy.split([1, 1], dim=-1)
+    xgrid = 2 * xgrid / (W - 1) - 1
+    ygrid = 2 * ygrid / (H - 1) - 1
+    grid = torch.cat([xgrid, ygrid], dim=-1)
+    return F.grid_sample(img_nchw, grid, align_corners=True)
+
+
+def test_coords_grid():
+    g = np.asarray(coords_grid(2, 3, 4))
+    assert g.shape == (2, 3, 4, 2)
+    # channel 0 is x (varies along width), channel 1 is y
+    np.testing.assert_array_equal(g[0, :, :, 0], np.tile(np.arange(4), (3, 1)))
+    np.testing.assert_array_equal(g[0, :, :, 1], np.tile(np.arange(3)[:, None], (1, 4)))
+    np.testing.assert_array_equal(g[0], g[1])
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_bilinear_sampler_matches_grid_sample(seed):
+    rng = np.random.RandomState(seed)
+    N, H, W, C = 2, 9, 13, 3
+    h2, w2 = 5, 7
+    img = rng.randn(N, H, W, C).astype(np.float32)
+    # coords spanning in-bounds, boundary, and well out-of-bounds
+    coords = rng.uniform(-3.0, max(H, W) + 2.0, size=(N, h2, w2, 2)).astype(np.float32)
+    coords[0, 0, 0] = [0.0, 0.0]
+    coords[0, 0, 1] = [W - 1.0, H - 1.0]
+    coords[0, 0, 2] = [-0.5, -0.5]
+
+    ours = np.asarray(bilinear_sampler(img, coords))
+
+    t_img = torch.from_numpy(img.transpose(0, 3, 1, 2))
+    t_coords = torch.from_numpy(coords)
+    ref = torch_bilinear_sampler(t_img, t_coords).numpy().transpose(0, 2, 3, 1)
+
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_resize_align_corners_matches_interpolate():
+    rng = np.random.RandomState(3)
+    img = rng.randn(2, 5, 6, 2).astype(np.float32)
+    ours = np.asarray(resize_bilinear_align_corners(img, 15, 18))
+    ref = (
+        F.interpolate(
+            torch.from_numpy(img.transpose(0, 3, 1, 2)),
+            size=(15, 18),
+            mode="bilinear",
+            align_corners=True,
+        )
+        .numpy()
+        .transpose(0, 2, 3, 1)
+    )
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_upflow8_matches_reference():
+    rng = np.random.RandomState(4)
+    flow = rng.randn(1, 6, 8, 2).astype(np.float32)
+    ours = np.asarray(upflow8(flow))
+    t = torch.from_numpy(flow.transpose(0, 3, 1, 2))
+    ref = (
+        (8 * F.interpolate(t, size=(48, 64), mode="bilinear", align_corners=True))
+        .numpy()
+        .transpose(0, 2, 3, 1)
+    )
+    assert ours.shape == (1, 48, 64, 2)
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,53 @@
+"""Parity test for sequence_loss vs. the reference (train.py:48-73)."""
+
+import numpy as np
+import pytest
+
+from dexiraft_tpu.ops import sequence_loss
+
+torch = pytest.importorskip("torch")
+
+
+def torch_sequence_loss(flow_preds, flow_gt, valid, gamma=0.8, max_flow=400.0):
+    n_predictions = len(flow_preds)
+    flow_loss = 0.0
+    mag = torch.sum(flow_gt**2, dim=1).sqrt()
+    valid = (valid >= 0.5) & (mag < max_flow)
+    for i in range(n_predictions):
+        i_weight = gamma ** (n_predictions - i - 1)
+        i_loss = (flow_preds[i] - flow_gt).abs()
+        flow_loss += i_weight * (valid[:, None] * i_loss).mean()
+    epe = torch.sum((flow_preds[-1] - flow_gt) ** 2, dim=1).sqrt()
+    epe = epe.view(-1)[valid.view(-1)]
+    metrics = {
+        "epe": epe.mean().item(),
+        "1px": (epe < 1).float().mean().item(),
+        "3px": (epe < 3).float().mean().item(),
+        "5px": (epe < 5).float().mean().item(),
+    }
+    return flow_loss.item(), metrics
+
+
+@pytest.mark.parametrize("gamma", [0.8, 0.85])
+def test_sequence_loss_matches_reference(gamma):
+    rng = np.random.RandomState(0)
+    iters, B, H, W = 5, 2, 8, 10
+    preds = rng.randn(iters, B, H, W, 2).astype(np.float32) * 3
+    gt = rng.randn(B, H, W, 2).astype(np.float32) * 3
+    # mix of valid/invalid and one huge-magnitude pixel to hit the mag mask
+    valid = (rng.rand(B, H, W) > 0.3).astype(np.float32)
+    gt[0, 0, 0] = [500.0, 0.0]
+
+    loss, metrics = sequence_loss(preds, gt, valid, gamma=gamma)
+
+    t_preds = [torch.from_numpy(p.transpose(0, 3, 1, 2)) for p in preds]
+    ref_loss, ref_metrics = torch_sequence_loss(
+        t_preds,
+        torch.from_numpy(gt.transpose(0, 3, 1, 2)),
+        torch.from_numpy(valid),
+        gamma=gamma,
+    )
+
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    for k in ref_metrics:
+        np.testing.assert_allclose(float(metrics[k]), ref_metrics[k], rtol=1e-4, atol=1e-5)
