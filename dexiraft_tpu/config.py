@@ -1,0 +1,137 @@
+"""Configuration tree for the framework.
+
+One resolved, immutable config replaces the reference's three independent
+argparse blocks plus the args-namespace mutation inside RAFT.__init__
+(core/raft.py:37-53) — configs here are frozen dataclasses, resolved once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTConfig:
+    """Architecture config covering the reference's five experiment variants
+    (SURVEY.md §2.5):
+
+      v1  variant='raft'                       vanilla RAFT, image stream only
+      v2  variant='early'                      6-ch early fusion (image ⊕ edge image from data)
+      v3  variant='separate'                   dual stream, edges from data, decoupled
+                                               updates + RefineFlow fusion
+      v4  variant='early',  embed_dexined=True 10-ch early fusion (image ⊕ 7 DexiNed logit maps)
+      v5  variant='dual',   embed_dexined=True dual stream w/ embedded frozen DexiNed,
+                                               shared update block, coupled Δf+Δef update
+    """
+
+    variant: str = "raft"  # raft | early | separate | dual
+    small: bool = False
+    embed_dexined: bool = False
+    corr_levels: int = 4
+    corr_radius: Optional[int] = None  # None -> 4 full / 3 small (core/raft.py:37-47)
+    dropout: float = 0.0
+    mixed_precision: bool = False  # bf16 compute in encoders/update; corr stays fp32
+    corr_impl: str = "allpairs"  # allpairs | local (on-demand, memory-efficient)
+
+    @property
+    def radius(self) -> int:
+        return self.corr_radius if self.corr_radius is not None else (3 if self.small else 4)
+
+    @property
+    def hidden_dim(self) -> int:
+        return 96 if self.small else 128
+
+    @property
+    def context_dim(self) -> int:
+        return 64 if self.small else 128
+
+    @property
+    def fnet_dim(self) -> int:
+        return 128 if self.small else 256
+
+    @property
+    def corr_planes(self) -> int:
+        return self.corr_levels * (2 * self.radius + 1) ** 2
+
+    @property
+    def image_channels(self) -> int:
+        if self.variant == "early":
+            return 10 if self.embed_dexined else 6
+        return 3
+
+    @property
+    def has_edge_stream(self) -> bool:
+        return self.variant in ("separate", "dual")
+
+
+def raft_v1(**kw) -> RAFTConfig:
+    return RAFTConfig(variant="raft", **kw)
+
+
+def raft_v2(**kw) -> RAFTConfig:
+    return RAFTConfig(variant="early", embed_dexined=False, **kw)
+
+
+def raft_v3(**kw) -> RAFTConfig:
+    return RAFTConfig(variant="separate", **kw)
+
+
+def raft_v4(**kw) -> RAFTConfig:
+    return RAFTConfig(variant="early", embed_dexined=True, **kw)
+
+
+def raft_v5(**kw) -> RAFTConfig:
+    return RAFTConfig(variant="dual", embed_dexined=True, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """One training stage. Presets mirror train_standard.sh / train_mixed.sh."""
+
+    name: str = "raft"
+    stage: str = "chairs"  # chairs | things | sintel | kitti
+    lr: float = 4e-4
+    num_steps: int = 100_000
+    batch_size: int = 10
+    image_size: Tuple[int, int] = (368, 496)
+    wdecay: float = 1e-4
+    epsilon: float = 1e-8
+    clip: float = 1.0
+    gamma: float = 0.8
+    iters: int = 12
+    add_noise: bool = False
+    freeze_bn: bool = False  # true for all post-chairs stages (train.py:149-150)
+    val_freq: int = 5000
+    sum_freq: int = 100
+    seed: int = 1234
+    validation: Tuple[str, ...] = ()
+
+
+# The 4-stage curriculum, standard recipe (train_standard.sh:3-6).
+STANDARD_STAGES = (
+    TrainConfig(name="raft-chairs", stage="chairs", validation=("chairs",), num_steps=100_000,
+                batch_size=10, lr=4e-4, image_size=(368, 496), wdecay=1e-4),
+    TrainConfig(name="raft-things", stage="things", validation=("sintel",), num_steps=100_000,
+                batch_size=6, lr=1.25e-4, image_size=(400, 720), wdecay=1e-4, freeze_bn=True),
+    TrainConfig(name="raft-sintel", stage="sintel", validation=("sintel",), num_steps=100_000,
+                batch_size=6, lr=1.25e-4, image_size=(368, 768), wdecay=1e-5, gamma=0.85,
+                freeze_bn=True),
+    TrainConfig(name="raft-kitti", stage="kitti", validation=("kitti",), num_steps=50_000,
+                batch_size=6, lr=1e-4, image_size=(288, 960), wdecay=1e-5, gamma=0.85,
+                freeze_bn=True),
+)
+
+# Mixed-precision single-chip recipe (train_mixed.sh:3-6).
+MIXED_STAGES = (
+    TrainConfig(name="raft-chairs", stage="chairs", validation=("chairs",), num_steps=120_000,
+                batch_size=8, lr=2.5e-4, image_size=(368, 496), wdecay=1e-4),
+    TrainConfig(name="raft-things", stage="things", validation=("sintel",), num_steps=120_000,
+                batch_size=5, lr=1e-4, image_size=(400, 720), wdecay=1e-4, freeze_bn=True),
+    TrainConfig(name="raft-sintel", stage="sintel", validation=("sintel",), num_steps=120_000,
+                batch_size=5, lr=1e-4, image_size=(368, 768), wdecay=1e-5, gamma=0.85,
+                freeze_bn=True),
+    TrainConfig(name="raft-kitti", stage="kitti", validation=("kitti",), num_steps=50_000,
+                batch_size=5, lr=1e-4, image_size=(288, 960), wdecay=1e-5, gamma=0.85,
+                freeze_bn=True),
+)

@@ -1,0 +1,26 @@
+"""Flax model zoo: encoders, update operators, DexiNed, and RAFT variants."""
+
+from dexiraft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from dexiraft_tpu.models.update import (
+    BasicUpdateBlock,
+    SmallUpdateBlock,
+    ConvGRU,
+    SepConvGRU,
+    FlowHead,
+    RefineFlow,
+)
+from dexiraft_tpu.models.dexined import DexiNed
+from dexiraft_tpu.models.raft import RAFT
+
+__all__ = [
+    "BasicEncoder",
+    "SmallEncoder",
+    "BasicUpdateBlock",
+    "SmallUpdateBlock",
+    "ConvGRU",
+    "SepConvGRU",
+    "FlowHead",
+    "RefineFlow",
+    "DexiNed",
+    "RAFT",
+]

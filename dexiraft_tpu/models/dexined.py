@@ -1,0 +1,218 @@
+"""DexiNed (Dense Extreme Inception Network for edge detection) in Flax.
+
+Re-design of the reference model (core/DexiNed/model.py:157-268): stem
+DoubleConvBlock, dense blocks with 0.5*(new+skip) fusion, left/right
+1x1-conv skip paths, transposed-conv upsamplers, and a final 1x1 fusion
+over the 6 concatenated scale outputs. Returns 7 maps (6 scales + fused),
+each (B, H, W, 1) of raw logits — the edge contract the v5 flow model
+consumes (core/raft.py:111-123, no sigmoid).
+
+NHWC; ``train`` toggles BatchNorm statistics (the flow model always calls
+with train=False — the embedded DexiNed is frozen; note the reference
+would let BN running stats drift during chairs-stage training, a bug we
+do not reproduce).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+xavier_normal = nn.initializers.glorot_normal()
+
+# torch ConvTranspose2d paddings per up_scale (core/DexiNed/model.py:93-96)
+_UPCONV_PAD = {1: 0, 2: 1, 3: 3, 4: 7}
+
+
+def _bn(train: bool, dtype):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=dtype)
+
+
+def _conv_transpose_torchlike(features: int, k: int, torch_pad: int, dtype):
+    """ConvTranspose matching torch's output size (in-1)*2 - 2p + k == 2*in.
+
+    lax.conv_transpose pads the dilated input, so torch padding p maps to
+    lax padding q = k - p - 1 per side (verified against torch in tests).
+    """
+    q = k - torch_pad - 1
+    return nn.ConvTranspose(
+        features, (k, k), strides=(2, 2), padding=((q, q), (q, q)),
+        kernel_init=xavier_normal if features > 1 else nn.initializers.normal(0.1),
+        dtype=dtype,
+    )
+
+
+class DoubleConvBlock(nn.Module):
+    """conv3x3(stride)+BN+relu -> conv3x3+BN(+relu). Reference model.py:129-154."""
+
+    mid_features: int
+    out_features: int | None = None
+    stride: int = 1
+    use_act: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_features = self.out_features if self.out_features is not None else self.mid_features
+        x = nn.Conv(self.mid_features, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, kernel_init=xavier_normal, dtype=self.dtype)(x)
+        x = nn.relu(_bn(train, self.dtype)(x))
+        x = nn.Conv(out_features, (3, 3), padding=1, kernel_init=xavier_normal,
+                    dtype=self.dtype)(x)
+        x = _bn(train, self.dtype)(x)
+        if self.use_act:
+            x = nn.relu(x)
+        return x
+
+
+class SingleConvBlock(nn.Module):
+    """1x1 conv (+BN). Reference model.py:112-126."""
+
+    out_features: int
+    stride: int = 1
+    use_bn: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.out_features, (1, 1), strides=(self.stride, self.stride),
+                    kernel_init=xavier_normal, dtype=self.dtype)(x)
+        if self.use_bn:
+            x = _bn(train, self.dtype)(x)
+        else:
+            # torch constructs self.bn unconditionally (model.py:120) so its
+            # params exist even when unused (block_cat); mirror that for
+            # param-count/checkpoint parity. Output discarded -> XLA DCEs it;
+            # running stats are never updated (use_running_average=True).
+            _ = _bn(False, self.dtype)(x)
+        return x
+
+
+class DenseLayer(nn.Module):
+    """relu -> conv3x3(pad 2) -> BN -> relu -> conv3x3(pad 0) -> BN, then
+    0.5 * (new + skip). The asymmetric paddings cancel so spatial size is
+    preserved. Reference model.py:49-69."""
+
+    out_features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x1, x2, train: bool = False):
+        y = nn.relu(x1)
+        y = nn.Conv(self.out_features, (3, 3), padding=2, kernel_init=xavier_normal,
+                    dtype=self.dtype)(y)
+        y = nn.relu(_bn(train, self.dtype)(y))
+        y = nn.Conv(self.out_features, (3, 3), padding=0, kernel_init=xavier_normal,
+                    dtype=self.dtype)(y)
+        y = _bn(train, self.dtype)(y)
+        return 0.5 * (y + x2), x2
+
+
+class DenseBlock(nn.Module):
+    """Chain of DenseLayers sharing one skip input. Reference model.py:72-78."""
+
+    num_layers: int
+    out_features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x1, x2, train: bool = False):
+        for _ in range(self.num_layers):
+            x1, x2 = DenseLayer(self.out_features, self.dtype)(x1, x2, train)
+        return x1
+
+
+class UpConvBlock(nn.Module):
+    """Stages of 1x1 conv + relu + 2x transposed conv; feature width 16
+    except the final stage which emits 1 channel. Reference model.py:81-109."""
+
+    up_scale: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k = 2 ** self.up_scale
+        pad = _UPCONV_PAD[self.up_scale]
+        for i in range(self.up_scale):
+            out_features = 1 if i == self.up_scale - 1 else 16
+            x = nn.Conv(out_features, (1, 1), kernel_init=xavier_normal, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = _conv_transpose_torchlike(out_features, k, pad, self.dtype)(x)
+        return x
+
+
+def _maxpool_3x3_s2(x):
+    # torch MaxPool2d(3, stride=2, padding=1): output size ceil(H/2)
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+class DexiNed(nn.Module):
+    """The full network. Reference model.py:157-268."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> List[jax.Array]:
+        dt = self.dtype
+
+        block_1 = DoubleConvBlock(32, 64, stride=2, dtype=dt)(x, train)
+        block_1_side = SingleConvBlock(128, stride=2, dtype=dt)(block_1, train)
+
+        block_2 = DoubleConvBlock(128, use_act=False, dtype=dt)(block_1, train)
+        block_2_down = _maxpool_3x3_s2(block_2)
+        block_2_add = block_2_down + block_1_side
+        block_2_side = SingleConvBlock(256, stride=2, dtype=dt)(block_2_add, train)
+
+        block_3_pre_dense = SingleConvBlock(256, dtype=dt)(block_2_down, train)
+        block_3 = DenseBlock(2, 256, dtype=dt)(block_2_add, block_3_pre_dense, train)
+        block_3_down = _maxpool_3x3_s2(block_3)
+        block_3_add = block_3_down + block_2_side
+        block_3_side = SingleConvBlock(512, stride=2, dtype=dt)(block_3_add, train)
+
+        block_4_pre_dense = SingleConvBlock(512, dtype=dt)(block_3_down, train)
+        block_4 = DenseBlock(3, 512, dtype=dt)(block_3_add, block_4_pre_dense, train)
+        block_4_down = _maxpool_3x3_s2(block_4)
+        block_4_add = block_4_down + block_3_side
+        block_4_side = SingleConvBlock(512, dtype=dt)(block_4_add, train)
+
+        block_5_pre_dense = SingleConvBlock(512, dtype=dt)(block_4_down, train)
+        block_5 = DenseBlock(3, 512, dtype=dt)(block_4_add, block_5_pre_dense, train)
+        block_5_add = block_5 + block_4_side
+        # side_5 is constructed but never used by the reference forward pass
+        # (model.py:175 vs. :234-238); keep its params for parity (dead, DCE'd)
+        _ = SingleConvBlock(256, dtype=dt, name="side_5")(block_5_add, False)
+
+        block_6_pre_dense = SingleConvBlock(256, dtype=dt)(block_5, train)
+        block_6 = DenseBlock(3, 256, dtype=dt)(block_5_add, block_6_pre_dense, train)
+
+        out_1 = UpConvBlock(1, dtype=dt)(block_1)
+        out_2 = UpConvBlock(1, dtype=dt)(block_2)
+        out_3 = UpConvBlock(2, dtype=dt)(block_3)
+        out_4 = UpConvBlock(3, dtype=dt)(block_4)
+        out_5 = UpConvBlock(4, dtype=dt)(block_5)
+        out_6 = UpConvBlock(4, dtype=dt)(block_6)
+
+        # crop deeper outputs when rounding made them overshoot
+        # (reference model.py:251-257)
+        h, w = out_1.shape[1], out_1.shape[2]
+        if out_5.shape[1:3] != (h, w):
+            h_off = out_5.shape[1] - h
+            w_off = out_5.shape[2] - w
+            assert h_off >= 0 and w_off >= 0
+            out_5 = out_5[:, h_off : h_off + h, w_off : w_off + w, :]
+            out_6 = out_6[:, h_off : h_off + h, w_off : w_off + w, :]
+
+        results = [out_1, out_2, out_3, out_4, out_5, out_6]
+        block_cat = jnp.concatenate(results, axis=-1)
+        block_cat = SingleConvBlock(1, use_bn=False, dtype=dt)(block_cat, train)
+        results.append(block_cat)
+        return results
+
+
+def stack_edge_maps(outputs: List[jax.Array]) -> jax.Array:
+    """Stack DexiNed's 7 per-scale logit maps into a (B, H, W, 7) tensor —
+    the raw-logit edge contract of the v5 flow model (core/raft.py:115-123)."""
+    return jnp.concatenate(outputs, axis=-1)

@@ -1,0 +1,150 @@
+"""Feature/context encoders at 1/8 resolution.
+
+Flax re-design of the reference encoders (core/extractor.py): BasicEncoder
+(residual blocks, 64->96->128 channels) and SmallEncoder (bottleneck
+blocks, 32->64->96), one shared scaffold parameterized by block type and
+stage widths, with the 4 norm modes and Kaiming fan-out init. NHWC
+throughout; ``dtype`` is the compute dtype (bf16 under mixed precision),
+params stay fp32.
+
+``train`` gates dropout; ``bn_train`` (defaulting to ``train``) gates
+BatchNorm statistics separately — the reference's freeze_bn only switches
+BatchNorm to eval while dropout stays governed by module training mode
+(core/raft.py:73-76 vs. core/extractor.py:186).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.models.layers import kaiming_normal_out, make_norm
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + skip; 1x1-conv downsample when strided.
+
+    Reference: core/extractor.py:6-56.
+    """
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, bn_train: bool = False):
+        groups = self.planes // 8
+        conv = lambda k, s: nn.Conv(  # noqa: E731
+            self.planes, (k, k), strides=(s, s), padding=k // 2,
+            kernel_init=kaiming_normal_out, dtype=self.dtype,
+        )
+        y = nn.relu(make_norm(self.norm_fn, groups, bn_train, self.dtype)(conv(3, self.stride)(x)))
+        y = nn.relu(make_norm(self.norm_fn, groups, bn_train, self.dtype)(conv(3, 1)(y)))
+
+        if self.stride != 1:
+            x = conv(1, self.stride)(x)
+            x = make_norm(self.norm_fn, groups, bn_train, self.dtype)(x)
+
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3(strided) -> 1x1 bottleneck (planes//4 inner width).
+
+    Reference: core/extractor.py:60-116.
+    """
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, bn_train: bool = False):
+        groups = self.planes // 8
+        quarter = self.planes // 4
+
+        def conv(features, k, s=1):
+            return nn.Conv(
+                features, (k, k), strides=(s, s), padding=k // 2,
+                kernel_init=kaiming_normal_out, dtype=self.dtype,
+            )
+
+        y = nn.relu(make_norm(self.norm_fn, groups, bn_train, self.dtype)(conv(quarter, 1)(x)))
+        y = nn.relu(make_norm(self.norm_fn, groups, bn_train, self.dtype)(conv(quarter, 3, self.stride)(y)))
+        y = nn.relu(make_norm(self.norm_fn, groups, bn_train, self.dtype)(conv(self.planes, 1)(y)))
+
+        if self.stride != 1:
+            x = conv(self.planes, 1, self.stride)(x)
+            x = make_norm(self.norm_fn, groups, bn_train, self.dtype)(x)
+
+        return nn.relu(x + y)
+
+
+class Encoder(nn.Module):
+    """Shared encoder scaffold: 7x7/2 stem -> 3 block stages -> 1x1 projection.
+
+    Output is 1/8 resolution. Accepts a tuple of images and concatenates
+    them on the batch dim (the reference's list-input batching trick,
+    core/extractor.py:168-191).
+    """
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    block: str = "residual"  # residual (Basic) | bottleneck (Small)
+    stem_width: int = 64
+    stages: Tuple[Tuple[int, int], ...] = ((64, 1), (96, 2), (128, 2))
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Union[jax.Array, Sequence[jax.Array]],
+        train: bool = False,
+        bn_train: Optional[bool] = None,
+    ):
+        if bn_train is None:
+            bn_train = train
+        block_cls = ResidualBlock if self.block == "residual" else BottleneckBlock
+
+        is_list = isinstance(x, (tuple, list))
+        if is_list:
+            batch_dim = x[0].shape[0]
+            x = jnp.concatenate(x, axis=0)
+
+        x = nn.Conv(self.stem_width, (7, 7), strides=(2, 2), padding=3,
+                    kernel_init=kaiming_normal_out, dtype=self.dtype)(x)
+        x = nn.relu(make_norm(self.norm_fn, 8, bn_train, self.dtype)(x))
+
+        for planes, stride in self.stages:
+            x = block_cls(planes, self.norm_fn, stride, self.dtype)(x, bn_train)
+            x = block_cls(planes, self.norm_fn, 1, self.dtype)(x, bn_train)
+
+        x = nn.Conv(self.output_dim, (1, 1), kernel_init=kaiming_normal_out,
+                    dtype=self.dtype)(x)
+
+        if self.dropout > 0.0:
+            # channel dropout (torch Dropout2d) — broadcast over spatial dims;
+            # gated by train, NOT bn_train (freeze_bn must not disable dropout)
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2), deterministic=not train)(x)
+
+        if is_list:
+            return x[:batch_dim], x[batch_dim:]
+        return x
+
+
+def BasicEncoder(output_dim=128, norm_fn="batch", dropout=0.0, dtype=jnp.float32, name=None):
+    """Residual encoder (64, 96/2, 128/2). Reference: core/extractor.py:118-192."""
+    return Encoder(output_dim, norm_fn, dropout, dtype, block="residual",
+                   stem_width=64, stages=((64, 1), (96, 2), (128, 2)), name=name)
+
+
+def SmallEncoder(output_dim=128, norm_fn="batch", dropout=0.0, dtype=jnp.float32, name=None):
+    """Bottleneck encoder (32, 64/2, 96/2). Reference: core/extractor.py:195-267."""
+    return Encoder(output_dim, norm_fn, dropout, dtype, block="bottleneck",
+                   stem_width=32, stages=((32, 1), (64, 2), (96, 2)), name=name)

@@ -1,0 +1,51 @@
+"""Shared layer helpers: normalization factory and initializers.
+
+The reference's four norm modes (group/batch/instance/none,
+core/extractor.py:16-38) with torch-matching hyperparameters:
+eps 1e-5 everywhere, BatchNorm momentum 0.1 (torch) == 0.9 (flax EMA),
+InstanceNorm affine-free (torch InstanceNorm2d default affine=False).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torch kaiming_normal_(mode='fan_out', nonlinearity='relu') — the extractor
+# init (core/extractor.py:150-157). Conv biases start at zero.
+kaiming_normal_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def make_norm(
+    norm_fn: str,
+    num_groups: int,
+    train: bool,
+    dtype=jnp.float32,
+) -> Callable:
+    """Return a fresh norm layer (or identity) for the given mode.
+
+    ``num_groups`` is only used for 'group'. For 'batch', ``train`` selects
+    batch statistics vs. running averages — the freeze_bn staging knob
+    (train.py:149-150) maps to calling with train=False.
+    """
+    if norm_fn == "group":
+        return nn.GroupNorm(num_groups=num_groups, epsilon=1e-5, dtype=dtype)
+    if norm_fn == "batch":
+        return nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=dtype
+        )
+    if norm_fn == "instance":
+        # per-sample, per-channel normalization; no learned affine
+        return nn.GroupNorm(
+            num_groups=None,
+            group_size=1,
+            use_scale=False,
+            use_bias=False,
+            epsilon=1e-5,
+            dtype=dtype,
+        )
+    if norm_fn == "none":
+        return lambda x: x
+    raise ValueError(f"unknown norm_fn: {norm_fn!r}")

@@ -1,0 +1,229 @@
+"""RAFT — the iterative optical-flow estimator, TPU-first.
+
+Re-design of the reference model family (core/raft.py and its raft_1..raft_4
+variants, SURVEY.md §2.5) as one Flax module driven by RAFTConfig:
+
+  v1 'raft'      image stream only (core/raft_1.py)
+  v2 'early'     6-ch early fusion, edges from data (core/raft_2.py)
+  v3 'separate'  dual stream, edges from data, decoupled updates +
+                 RefineFlow fusion (core/raft_3.py, output-width bug fixed)
+  v4 'early'+embed_dexined   10-ch early fusion, embedded DexiNed (core/raft_4.py)
+  v5 'dual'+embed_dexined    dual stream, frozen DexiNed, shared update block,
+                 coupled update coords1 += Δflow + Δeflow (core/raft.py:183)
+
+TPU-first design choices (vs. the reference's Python loop over CUDA calls):
+  * the refinement loop is nn.scan (lax.scan) with weights broadcast — all
+    iterations compile into ONE on-device graph; `iters` is static.
+  * NHWC layouts; under mixed_precision encoders/update run in bf16 while
+    the correlation volume stays fp32 (mirrors core/raft.py:134-148).
+  * the correlation pyramid is a pytree threaded through the scan carry —
+    XLA hoists it as loop-invariant.
+  * coords are stop_gradient'ed at each iteration start, matching the
+    reference's per-iteration detach (core/raft.py:170-171).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.config import RAFTConfig
+from dexiraft_tpu.models.dexined import DexiNed, stack_edge_maps
+from dexiraft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from dexiraft_tpu.models.update import BasicUpdateBlock, RefineFlow, SmallUpdateBlock
+from dexiraft_tpu.ops.corr import build_corr_pyramid
+from dexiraft_tpu.ops.grid import coords_grid, upflow8
+from dexiraft_tpu.ops.upsample import upsample_flow_convex
+
+
+def _normalize(img: jax.Array) -> jax.Array:
+    """[0, 255] -> [-1, 1] (core/raft.py:104-105)."""
+    return 2.0 * (img / 255.0) - 1.0
+
+
+class RAFTStep(nn.Module):
+    """One refinement iteration; scanned with params broadcast."""
+
+    cfg: RAFTConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry: Dict[str, Any], _):
+        cfg = self.cfg
+        if cfg.small:
+            update_block = SmallUpdateBlock(hidden_dim=cfg.hidden_dim, dtype=self.dtype)
+        else:
+            update_block = BasicUpdateBlock(hidden_dim=cfg.hidden_dim, dtype=self.dtype)
+
+        pyr = carry["pyr"]
+        coords0 = coords_grid(pyr.batch, pyr.ht, pyr.wd)
+
+        coords1 = jax.lax.stop_gradient(carry["coords1"])
+        corr = pyr(coords1)
+        flow = coords1 - coords0
+        net, up_mask, delta_flow = update_block(carry["net"], carry["inp"], corr, flow)
+        delta_flow = delta_flow.astype(jnp.float32)
+
+        if cfg.has_edge_stream:
+            ecoords1 = jax.lax.stop_gradient(carry["ecoords1"])
+            ecorr = carry["epyr"](ecoords1)
+            eflow = ecoords1 - coords0
+            enet, eup_mask, delta_eflow = update_block(
+                carry["enet"], carry["einp"], ecorr, eflow
+            )
+            delta_eflow = delta_eflow.astype(jnp.float32)
+
+            if cfg.variant == "dual":
+                # coupled update: edge deltas injected into the image flow
+                # (core/raft.py:183-184)
+                coords1 = coords1 + delta_flow + delta_eflow
+                ecoords1 = ecoords1 + delta_eflow
+            else:  # 'separate' (v3): decoupled (core/raft_3.py:160-161)
+                coords1 = coords1 + delta_flow
+                ecoords1 = ecoords1 + delta_eflow
+            carry = {**carry, "ecoords1": ecoords1, "enet": enet}
+        else:
+            coords1 = coords1 + delta_flow
+
+        flow_up = self._upsample(coords1 - coords0, up_mask)
+
+        if cfg.variant == "separate":
+            eflow_up = self._upsample(ecoords1 - coords0, eup_mask)
+            prediction = RefineFlow(dtype=self.dtype)(flow_up, eflow_up).astype(jnp.float32)
+        else:
+            prediction = flow_up
+
+        carry = {**carry, "coords1": coords1, "net": net}
+        return carry, prediction
+
+    def _upsample(self, flow: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+        if mask is None:  # small model has no mask head (core/raft.py:187-190)
+            return upflow8(flow)
+        return upsample_flow_convex(flow.astype(jnp.float32), mask.astype(jnp.float32))
+
+
+class RAFT(nn.Module):
+    """Full model: encoders + correlation pyramids + scanned refinement."""
+
+    cfg: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(
+        self,
+        image1: jax.Array,
+        image2: jax.Array,
+        edges1: Optional[jax.Array] = None,
+        edges2: Optional[jax.Array] = None,
+        iters: int = 12,
+        flow_init: Optional[jax.Array] = None,
+        train: bool = False,
+        freeze_bn: bool = False,
+        test_mode: bool = False,
+    ):
+        """Estimate flow between two (B, H, W, 3) [0,255] frames.
+
+        edges1/edges2: (B, H, W, 3) edge images for the v2/v3 variants
+        (data-supplied edge contract); ignored when embed_dexined=True.
+
+        Returns stacked per-iteration upsampled flows (iters, B, H, W, 2),
+        or (flow_low, flow_up) in test_mode (core/raft.py:194-197).
+        """
+        cfg = self.cfg
+        if cfg.corr_impl != "allpairs":
+            raise NotImplementedError(
+                f"corr_impl={cfg.corr_impl!r} is not wired up yet; only "
+                "'allpairs' (materialized volume) is available"
+            )
+        if cfg.variant == "dual" and not cfg.embed_dexined:
+            raise ValueError(
+                "variant='dual' requires embed_dexined=True (the v5 edge "
+                "stream consumes DexiNed's 7 logit maps; use raft_v5())"
+            )
+        dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+
+        image1 = _normalize(image1.astype(jnp.float32))
+        image2 = _normalize(image2.astype(jnp.float32))
+
+        em1 = em2 = None
+        if cfg.embed_dexined:
+            # frozen edge extraction: raw logits, gradients stopped — the
+            # no_grad contract of core/raft.py:111-123
+            dexined = DexiNed(dtype=jnp.float32)
+            em1 = jax.lax.stop_gradient(stack_edge_maps(dexined(image1, train=False)))
+            em2 = jax.lax.stop_gradient(stack_edge_maps(dexined(image2, train=False)))
+        elif cfg.variant in ("early", "separate"):
+            if edges1 is None or edges2 is None:
+                raise ValueError(
+                    f"variant {cfg.variant!r} without embed_dexined requires "
+                    "data-supplied edges1/edges2"
+                )
+            em1 = _normalize(edges1.astype(jnp.float32))
+            em2 = _normalize(edges2.astype(jnp.float32))
+
+        if cfg.variant == "early":
+            image1 = jnp.concatenate([image1, em1], axis=-1)
+            image2 = jnp.concatenate([image2, em2], axis=-1)
+            em1 = em2 = None
+
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+        Encoder = SmallEncoder if cfg.small else BasicEncoder
+        enc_norm = "instance"
+        ctx_norm = "none" if cfg.small else "batch"
+        # freeze_bn: post-chairs stages run BN on running stats (train.py:149-150)
+        bn_train = train and not freeze_bn
+
+        fnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype, name="fnet")
+        cnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype, name="cnet")
+
+        fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
+                            train=train, bn_train=bn_train)
+        fmap1, fmap2 = fmap1.astype(jnp.float32), fmap2.astype(jnp.float32)
+        pyr = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels, cfg.radius)
+
+        ctx = cnet(image1.astype(dtype), train=train, bn_train=bn_train)
+        net = jnp.tanh(ctx[..., :hdim])
+        inp = nn.relu(ctx[..., hdim:])
+
+        b, h8, w8 = pyr.batch, pyr.ht, pyr.wd
+        coords0 = coords_grid(b, h8, w8)
+        coords1 = coords_grid(b, h8, w8)
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        carry: Dict[str, Any] = {"coords1": coords1, "net": net, "inp": inp, "pyr": pyr}
+
+        if cfg.has_edge_stream:
+            if cfg.variant == "dual":
+                # v5: dedicated 7-channel edge encoders (core/raft.py:61-71)
+                efnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype, name="efnet")
+                ecnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype, name="ecnet")
+            else:
+                # v3: image and edge streams share fnet/cnet (core/raft_3.py:110-127)
+                efnet, ecnet = fnet, cnet
+            fem1, fem2 = efnet((em1.astype(dtype), em2.astype(dtype)),
+                               train=train, bn_train=bn_train)
+            fem1, fem2 = fem1.astype(jnp.float32), fem2.astype(jnp.float32)
+            epyr = build_corr_pyramid(fem1, fem2, cfg.corr_levels, cfg.radius)
+            ectx = ecnet(em1.astype(dtype), train=train, bn_train=bn_train)
+            carry.update(
+                ecoords1=coords_grid(b, h8, w8),
+                enet=jnp.tanh(ectx[..., :hdim]),
+                einp=nn.relu(ectx[..., hdim:]),
+                epyr=epyr,
+            )
+
+        scan = nn.scan(
+            RAFTStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            length=iters,
+        )
+        carry, predictions = scan(cfg=cfg, dtype=dtype)(carry, None)
+
+        if test_mode:
+            flow_low = carry["coords1"] - coords0
+            return flow_low, predictions[-1]
+        return predictions

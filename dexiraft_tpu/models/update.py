@@ -1,0 +1,176 @@
+"""Update operators: motion encoders, ConvGRU cells, flow/mask heads.
+
+Flax re-design of the reference update blocks (core/update.py) plus the
+corrected RefineFlow fusion head from the v3 variant (core/update_3.py:138-151
+— the reference's version outputs 1 channel where flow needs 2, which made
+v3 diverge; ours outputs 2 and documents the deviation).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FlowHead(nn.Module):
+    """conv3x3 -> relu -> conv3x3 to a 2-channel flow delta.
+
+    Reference: core/update.py:6-14.
+    """
+
+    hidden_dim: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(self.hidden_dim, (3, 3), padding=1, dtype=self.dtype)(x))
+        return nn.Conv(2, (3, 3), padding=1, dtype=self.dtype)(x)
+
+
+class ConvGRU(nn.Module):
+    """3x3 convolutional GRU. Reference: core/update.py:16-31."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(nn.Conv(self.hidden_dim, (3, 3), padding=1, dtype=self.dtype)(hx))
+        r = nn.sigmoid(nn.Conv(self.hidden_dim, (3, 3), padding=1, dtype=self.dtype)(hx))
+        q = nn.tanh(
+            nn.Conv(self.hidden_dim, (3, 3), padding=1, dtype=self.dtype)(
+                jnp.concatenate([r * h, x], axis=-1)
+            )
+        )
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Separable GRU: a 1x5 horizontal pass then a 5x1 vertical pass.
+
+    Reference: core/update.py:33-60.
+    """
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        def gru_pass(h, x, ksize):
+            conv = lambda: nn.Conv(  # noqa: E731
+                self.hidden_dim, ksize,
+                padding=((ksize[0] // 2, ksize[0] // 2), (ksize[1] // 2, ksize[1] // 2)),
+                dtype=self.dtype,
+            )
+            hx = jnp.concatenate([h, x], axis=-1)
+            z = nn.sigmoid(conv()(hx))
+            r = nn.sigmoid(conv()(hx))
+            q = nn.tanh(conv()(jnp.concatenate([r * h, x], axis=-1)))
+            return (1 - z) * h + z * q
+
+        h = gru_pass(h, x, (1, 5))  # horizontal
+        h = gru_pass(h, x, (5, 1))  # vertical
+        return h
+
+
+class SmallMotionEncoder(nn.Module):
+    """Embed (corr, flow) -> 82-channel motion features.
+
+    Reference: core/update.py:62-77.
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(nn.Conv(96, (1, 1), dtype=self.dtype)(corr))
+        flo = nn.relu(nn.Conv(64, (7, 7), padding=3, dtype=self.dtype)(flow))
+        flo = nn.relu(nn.Conv(32, (3, 3), padding=1, dtype=self.dtype)(flo))
+        out = nn.relu(
+            nn.Conv(80, (3, 3), padding=1, dtype=self.dtype)(
+                jnp.concatenate([cor, flo], axis=-1)
+            )
+        )
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class BasicMotionEncoder(nn.Module):
+    """Embed (corr, flow) -> 128-channel motion features.
+
+    Reference: core/update.py:79-97.
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(nn.Conv(256, (1, 1), dtype=self.dtype)(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3), padding=1, dtype=self.dtype)(cor))
+        flo = nn.relu(nn.Conv(128, (7, 7), padding=3, dtype=self.dtype)(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3), padding=1, dtype=self.dtype)(flo))
+        out = nn.relu(
+            nn.Conv(128 - 2, (3, 3), padding=1, dtype=self.dtype)(
+                jnp.concatenate([cor, flo], axis=-1)
+            )
+        )
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class SmallUpdateBlock(nn.Module):
+    """Motion encoder + ConvGRU + flow head; no upsampling mask.
+
+    Reference: core/update.py:99-112.
+    """
+
+    hidden_dim: int = 96
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = SmallMotionEncoder(self.dtype)(flow, corr)
+        net = ConvGRU(self.hidden_dim, self.dtype)(net, jnp.concatenate([inp, motion], axis=-1))
+        delta_flow = FlowHead(128, self.dtype)(net)
+        return net, None, delta_flow
+
+
+class BasicUpdateBlock(nn.Module):
+    """Motion encoder + SepConvGRU + flow head + convex-upsampling mask head.
+
+    The mask logits are scaled by 0.25 to balance gradients
+    (core/update.py:114-136).
+    """
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = BasicMotionEncoder(self.dtype)(flow, corr)
+        net = SepConvGRU(self.hidden_dim, self.dtype)(net, jnp.concatenate([inp, motion], axis=-1))
+        delta_flow = FlowHead(256, self.dtype)(net)
+
+        mask = nn.relu(nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)(net))
+        mask = 0.25 * nn.Conv(64 * 9, (1, 1), dtype=self.dtype)(mask)
+        return net, mask, delta_flow
+
+
+class RefineFlow(nn.Module):
+    """1x1-conv fusion of (flow_up, eflow_up) -> refined 2-channel flow.
+
+    Capability parity with the v3 variant's refine block
+    (core/update_3.py:138-151) with the output-width bug fixed: the
+    reference conv maps 4 channels to **1**, shape-incompatible with the
+    2-channel flow loss (this is why v3 diverged, SURVEY.md §2.5); ours
+    maps 4 -> 2.
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow_up, eflow_up):
+        fused = jnp.concatenate([flow_up, eflow_up], axis=-1)
+        return nn.Conv(2, (1, 1), dtype=self.dtype)(fused)

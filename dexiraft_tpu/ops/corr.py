@@ -2,8 +2,9 @@
 
 TPU-native re-design of the reference centerpiece (core/corr.py:12-60):
 the volume is one big batched matmul (MXU-friendly), the pyramid is
-reduce_window average pooling, and the per-iteration lookup gathers a
-(2r+1)^2 bilinear window per pixel per level.
+slice+reshape-mean 2x2 average pooling (NOT lax.reduce_window — see
+avg_pool_2x2), and the per-iteration lookup gathers a (2r+1)^2 bilinear
+window per pixel per level.
 
 Layouts: feature maps are (B, H, W, D); the flattened volume is
 (B*H*W, H_l, W_l, 1) per level — same flattening the reference uses so the

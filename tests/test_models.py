@@ -1,0 +1,157 @@
+"""Model parity tests.
+
+The strongest cheap parity signal: exact parameter-count matches against
+the reference (counted from /root/reference logs line 2 and verified by
+instantiating the torch modules — see BASELINE.md):
+
+  v1 vanilla RAFT (full)           5,257,536
+  v2 early fusion 6-ch             5,276,352
+  v4 early fusion 10-ch + DexiNed  40,483,149
+  v5 dual stream + DexiNed         42,600,909
+  raft-small (v1 small)              990,162
+  DexiNed alone                    35,181,709
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dexiraft_tpu.config import RAFTConfig, raft_v1, raft_v2, raft_v3, raft_v4, raft_v5
+from dexiraft_tpu.models import DexiNed, RAFT
+
+
+def n_params(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(tree))
+
+
+def init_raft(cfg: RAFTConfig, h=64, w=64, with_edges=False):
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jnp.zeros((1, h, w, 3))
+    kwargs = {}
+    if with_edges:
+        kwargs = dict(edges1=img, edges2=img)
+    variables = model.init(rng, img, img, iters=1, **kwargs)
+    return model, variables
+
+
+@pytest.mark.parametrize(
+    "cfg,expected",
+    [
+        (raft_v1(), 5_257_536),
+        (raft_v2(), 5_276_352),
+        (raft_v4(), 40_483_149),
+        (raft_v5(), 42_600_909),
+        (raft_v1(small=True), 990_162),
+    ],
+    ids=["v1", "v2", "v4", "v5", "small"],
+)
+def test_param_count_parity(cfg, expected):
+    _, variables = init_raft(cfg, with_edges=cfg.variant == "early" and not cfg.embed_dexined)
+    assert n_params(variables["params"]) == expected
+
+
+def test_param_count_v3_corrected_refineflow():
+    # reference v3 counts 5,257,541 with its buggy 4->1 RefineFlow (5 params);
+    # ours is corrected to 4->2 (10 params): 5,257,546.
+    _, variables = init_raft(raft_v3(), with_edges=True)
+    assert n_params(variables["params"]) == 5_257_546
+
+
+def test_dexined_param_count_and_shapes():
+    model = DexiNed()
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    assert n_params(variables["params"]) == 35_181_709
+
+    outs = model.apply(variables, x)
+    assert len(outs) == 7  # 6 scales + fused (core/DexiNed/model.py:260-268)
+    for o in outs:
+        assert o.shape == (1, 64, 64, 1)
+
+
+def test_conv_transpose_matches_torch_geometry():
+    torch = pytest.importorskip("torch")
+    import flax.linen as nn
+
+    from dexiraft_tpu.models.dexined import _conv_transpose_torchlike
+
+    for up_scale, pad in [(1, 0), (2, 1), (3, 3), (4, 7)]:
+        k = 2**up_scale
+        t = torch.nn.ConvTranspose2d(3, 3, k, stride=2, padding=pad)
+        t_out = t(torch.zeros(1, 3, 10, 10)).shape[-2:]
+        m = _conv_transpose_torchlike(3, k, pad, jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 10, 10, 3)))
+        j_out = m.apply(v, jnp.zeros((1, 10, 10, 3))).shape[1:3]
+        assert tuple(t_out) == tuple(j_out) == (20, 20)
+
+
+def test_forward_shapes_and_test_mode():
+    cfg = raft_v1(small=True)
+    model, variables = init_raft(cfg)
+    img = jnp.ones((2, 64, 72, 3)) * 127.0
+
+    preds = model.apply(variables, img, img, iters=3)
+    assert preds.shape == (3, 2, 64, 72, 2)
+
+    flow_low, flow_up = model.apply(variables, img, img, iters=3, test_mode=True)
+    assert flow_low.shape == (2, 8, 9, 2)
+    assert flow_up.shape == (2, 64, 72, 2)
+    np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(flow_up), rtol=1e-5)
+
+
+def test_forward_identical_images_small_flow():
+    # identical frames => the model should keep flow near its zero init
+    cfg = raft_v1(small=True)
+    model, variables = init_raft(cfg)
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.rand(1, 64, 64, 3) * 255.0)
+    preds = model.apply(variables, img, img, iters=4)
+    assert np.isfinite(np.asarray(preds)).all()
+
+
+def test_flow_init_warm_start_shifts_result():
+    cfg = raft_v1(small=True)
+    model, variables = init_raft(cfg)
+    img = jnp.ones((1, 64, 64, 3)) * 100.0
+    flow_init = jnp.ones((1, 8, 8, 2)) * 2.0
+    low0, _ = model.apply(variables, img, img, iters=1, test_mode=True)
+    low1, _ = model.apply(variables, img, img, iters=1, flow_init=flow_init, test_mode=True)
+    # warm start must move the starting coords (core/raft.py:165-166)
+    assert float(jnp.abs(low1 - low0).max()) > 0.5
+
+
+def test_dual_stream_jit_and_grad():
+    cfg = raft_v5(small=True)
+    model, variables = init_raft(cfg)
+    img = jnp.ones((1, 64, 64, 3)) * 127.0
+
+    @jax.jit
+    def run(v, a, b):
+        return model.apply(v, a, b, iters=2)
+
+    preds = run(variables, img, img)
+    assert preds.shape == (2, 1, 64, 64, 2)
+
+    # gradients must NOT flow into the frozen DexiNed (no_grad contract)
+    def loss(params):
+        p = model.apply({"params": params, **{k: v for k, v in variables.items() if k != "params"}},
+                        img, img, iters=2)
+        return jnp.abs(p).sum()
+
+    grads = jax.grad(loss)(variables["params"])
+    dexi_grad = grads["dexined"] if "dexined" in grads else grads["DexiNed_0"]
+    assert max(float(jnp.abs(g).max()) for g in jax.tree_util.tree_leaves(dexi_grad)) == 0.0
+    fnet_grad = grads["fnet"]
+    assert max(float(jnp.abs(g).max()) for g in jax.tree_util.tree_leaves(fnet_grad)) > 0.0
+
+
+def test_mixed_precision_runs_bf16():
+    cfg = raft_v1(small=True, mixed_precision=True)
+    model, variables = init_raft(cfg)
+    img = jnp.ones((1, 64, 64, 3)) * 127.0
+    preds = model.apply(variables, img, img, iters=2)
+    # predictions come back fp32 (corr + coords path stays fp32)
+    assert preds.dtype == jnp.float32
+    assert np.isfinite(np.asarray(preds)).all()
