@@ -1,0 +1,24 @@
+"""Device meshes, sharding rules, and collective helpers.
+
+The reference's entire parallelism story is single-process
+torch.nn.DataParallel (train.py:139, SURVEY.md §2.7). The TPU-native
+equivalent is declarative: build a jax.sharding.Mesh over the chips,
+shard the batch over the 'data' axis, replicate parameters, and let the
+SPMD partitioner insert the gradient all-reduce over ICI.
+"""
+
+from dexiraft_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "make_mesh",
+    "replicated_sharding",
+    "shard_batch",
+]

@@ -1,0 +1,49 @@
+"""1-D data-parallel mesh over all chips.
+
+Replaces torch.nn.DataParallel's replicate/scatter/gather (train.py:139)
+with a jax.sharding.Mesh: batch arrays are sharded over the 'data' axis,
+parameters are replicated, and XLA's SPMD partitioner inserts the
+gradient all-reduce (psum over ICI) during autodiff of the sharded
+computation — no imperative communication code at all.
+
+Multi-host: jax.devices() already enumerates every chip in the slice, so
+the same mesh spans hosts; DCN axes would only be needed for multi-slice
+(not required for parity, SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (parameters, optimizer state, scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis: str = DATA_AXIS) -> Any:
+    """Device-put every leaf of a host batch with its leading dim sharded.
+
+    The per-host analog of DataParallel's scatter (but zero-copy once the
+    arrays are on device; donation happens in the jitted step).
+    """
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

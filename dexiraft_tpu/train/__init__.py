@@ -1,0 +1,14 @@
+"""Training layer: optimizer/schedule, train state, jitted step, checkpointing, logging."""
+
+from dexiraft_tpu.train.optimizer import make_optimizer, onecycle_lr
+from dexiraft_tpu.train.state import TrainState, create_state
+from dexiraft_tpu.train.step import make_eval_step, make_train_step
+
+__all__ = [
+    "TrainState",
+    "create_state",
+    "make_eval_step",
+    "make_optimizer",
+    "make_train_step",
+    "onecycle_lr",
+]

@@ -1,0 +1,89 @@
+"""Orbax checkpointing: full train state + partial (curriculum) restore.
+
+Upgrades the reference's torch.save(model.state_dict()) every 5k steps
+(train.py:189-190): here params, BatchNorm stats, optimizer state, step,
+and PRNG key all round-trip, so resume continues the OneCycle schedule
+instead of restarting it (the reference's documented gap, SURVEY.md §5).
+
+``restore_params_into`` reproduces load_state_dict(strict=False)
+(train.py:143-144): stage-to-stage and architecture-drift loads keep every
+leaf whose path and shape match and leave the rest freshly initialized.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from dexiraft_tpu.train.state import TrainState
+
+
+def _manager(directory: str, max_to_keep: Optional[int] = None) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+    )
+
+
+def save_checkpoint(directory: str, state: TrainState, step: Optional[int] = None) -> None:
+    """Write <directory>/<step>/ with the full state (blocking)."""
+    mgr = _manager(directory)
+    s = int(state.step) if step is None else int(step)
+    mgr.save(s, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_checkpoint(
+    directory: str, template: TrainState, step: Optional[int] = None
+) -> TrainState:
+    """Restore a full TrainState; ``template`` supplies tree structure,
+    shapes, and shardings (create one with create_state)."""
+    mgr = _manager(directory)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    return restored
+
+
+def restore_params_into(
+    params: Any, restored_params: Any, verbose: bool = False
+) -> Tuple[Any, list]:
+    """strict=False load: graft every leaf whose path exists in both trees
+    with matching shape; keep the fresh init elsewhere. Returns (merged,
+    list of skipped/missing path strings)."""
+    flat_new = {jax.tree_util.keystr(kp): v
+                for kp, v in jax.tree_util.tree_flatten_with_path(params)[0]}
+    flat_old = {jax.tree_util.keystr(kp): v
+                for kp, v in jax.tree_util.tree_flatten_with_path(restored_params)[0]}
+
+    skipped = []
+    merged = dict(flat_new)
+    for key, new_leaf in flat_new.items():
+        old = flat_old.get(key)
+        if old is not None and tuple(old.shape) == tuple(new_leaf.shape):
+            merged[key] = old
+        else:
+            skipped.append(key)
+    skipped += [k for k in flat_old if k not in flat_new]
+    if verbose and skipped:
+        print(f"[checkpoint] partial restore skipped {len(skipped)} leaves: {skipped[:8]}…")
+
+    # rebuild the tree: map leaves back by path order
+    paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [merged[jax.tree_util.keystr(kp)] for kp, _ in paths]
+    return jax.tree_util.tree_unflatten(treedef, leaves), skipped

@@ -1,0 +1,94 @@
+"""Metrics logger: running means -> stdout + JSONL + optional TensorBoard.
+
+Reproduces the reference Logger (train.py:90-134): running means over
+SUM_FREQ steps, a formatted "[step, lr] epe 1px 3px 5px" stdout line, and
+TensorBoard scalars. Adds a machine-readable metrics.jsonl (the TPU plan's
+observability upgrade, SURVEY.md §5) and an iters/sec meter — the
+north-star throughput metric the reference never recorded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class Logger:
+    def __init__(
+        self,
+        sum_freq: int = 100,
+        log_dir: Optional[str] = None,
+        tensorboard: bool = True,
+        model_iters: int = 12,
+    ):
+        self.sum_freq = sum_freq
+        self.log_dir = log_dir
+        self.model_iters = model_iters
+        self.total_steps = 0
+        self.running: Dict[str, float] = {}
+        self._tb = None
+        self._jsonl = None
+        self._t0 = time.perf_counter()
+        self._steps_since = 0
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+            if tensorboard:
+                try:
+                    from torch.utils.tensorboard import SummaryWriter
+
+                    self._tb = SummaryWriter(log_dir)
+                except Exception:
+                    self._tb = None
+
+    def push(self, metrics: Dict[str, float]) -> None:
+        """Accumulate one step's metrics; emit every sum_freq steps.
+
+        Device arrays are accumulated as-is (the add dispatches async) and
+        only materialized on the host in _emit — push never blocks on the
+        jitted step, preserving async dispatch between steps.
+        """
+        self.total_steps += 1
+        self._steps_since += 1
+        for k, v in metrics.items():
+            self.running[k] = self.running.get(k, 0.0) + v
+        if self.total_steps % self.sum_freq == 0:
+            self._emit()
+
+    def _emit(self) -> None:
+        n = max(self._steps_since, 1)
+        means = {k: float(v) / n for k, v in self.running.items()}
+        dt = time.perf_counter() - self._t0
+        steps_per_sec = n / dt if dt > 0 else 0.0
+        means["steps/sec"] = steps_per_sec
+        means["iters/sec"] = steps_per_sec * self.model_iters
+
+        lr = means.get("lr", 0.0)
+        keys = [k for k in ("epe", "1px", "3px", "5px", "loss") if k in means]
+        body = ", ".join(f"{means[k]:10.4f}" for k in keys)
+        print(f"[{self.total_steps:6d}, {lr:10.7f}] {body}  ({steps_per_sec:.2f} steps/s)")
+
+        self._write(means, self.total_steps)
+        self.running = {}
+        self._steps_since = 0
+        self._t0 = time.perf_counter()
+
+    def write_dict(self, results: Dict[str, float], step: Optional[int] = None) -> None:
+        """Validation results (train.py:126-131)."""
+        self._write(results, self.total_steps if step is None else step)
+
+    def _write(self, scalars: Dict[str, float], step: int) -> None:
+        if self._jsonl:
+            self._jsonl.write(json.dumps({"step": step, **{k: float(v) for k, v in scalars.items()}}) + "\n")
+            self._jsonl.flush()
+        if self._tb:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, float(v), step)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+        if self._tb:
+            self._tb.close()

@@ -1,0 +1,66 @@
+"""AdamW + OneCycle schedule + global-norm clipping.
+
+Reproduces fetch_optimizer (train.py:80-87): AdamW(lr, wdecay, eps) under
+torch OneCycleLR(max_lr=lr, total_steps=num_steps+100, pct_start=0.05,
+anneal_strategy='linear'), with clip_grad_norm_(1.0) applied before the
+step (train.py:182).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def onecycle_lr(
+    max_lr: float,
+    total_steps: int,
+    pct_start: float = 0.05,
+    div_factor: float = 25.0,
+    final_div_factor: float = 1e4,
+):
+    """Linear one-cycle schedule matching torch OneCycleLR(anneal='linear').
+
+    Phase 1 (pct_start of total): linear  max_lr/div_factor -> max_lr.
+    Phase 2 (rest):               linear  max_lr -> max_lr/(div_factor*final_div_factor).
+
+    torch counts schedule steps from 1..total and errors past total; we
+    clamp instead so the +100 slack steps (train.py:84) are harmless.
+    """
+    initial = max_lr / div_factor
+    final = initial / final_div_factor
+    # torch's phase boundary: float(pct_start * total_steps) - 1 steps in phase 1;
+    # floor at a tiny positive value so degenerate totals (pct_start*total <= 1)
+    # degrade to an immediate-peak schedule instead of 0/0 = NaN
+    up_steps = max(pct_start * total_steps - 1.0, 1e-6)
+
+    def schedule(step):
+        step = jnp.minimum(jnp.asarray(step, jnp.float32), total_steps - 1.0)
+        up = initial + (max_lr - initial) * jnp.minimum(step / up_steps, 1.0)
+        down_frac = (step - up_steps) / ((total_steps - 1.0) - up_steps)
+        down = max_lr + (final - max_lr) * jnp.clip(down_frac, 0.0, 1.0)
+        return jnp.where(step <= up_steps, up, down)
+
+    return schedule
+
+
+def training_schedule(lr: float, num_steps: int):
+    """The schedule actually used for training: OneCycle over num_steps+100
+    (the reference's slack, train.py:84). Single source of truth for both
+    the optimizer and the lr reported in metrics."""
+    return onecycle_lr(lr, num_steps + 100)
+
+
+def make_optimizer(
+    lr: float,
+    num_steps: int,
+    wdecay: float = 1e-4,
+    epsilon: float = 1e-8,
+    clip: float = 1.0,
+) -> optax.GradientTransformation:
+    """clip-by-global-norm -> AdamW(OneCycle). Matches train.py:80-87."""
+    schedule = training_schedule(lr, num_steps)
+    tx = optax.adamw(schedule, b1=0.9, b2=0.999, eps=epsilon, weight_decay=wdecay)
+    if clip and clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    return tx

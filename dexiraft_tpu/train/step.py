@@ -1,0 +1,147 @@
+"""Jitted train / eval steps, single-chip or sharded over a device mesh.
+
+The reference's inner loop (train.py:163-186: forward, sequence loss,
+backward, unscale/clip/step, scheduler) becomes ONE jitted function —
+the 12-iteration refinement loop, loss, and optimizer update all compile
+into a single on-device graph. Data parallelism is declarative: the batch
+is sharded over the mesh's 'data' axis, the state is replicated, and the
+SPMD partitioner inserts the gradient all-reduce over ICI (the TPU-native
+replacement for DataParallel's NCCL gather, SURVEY.md §2.7).
+
+BatchNorm note: under a sharded batch the normalizing statistics are
+GLOBAL across chips (XLA inserts the cross-chip mean) — i.e. sync-BN.
+The reference's DataParallel computes per-device stats; sync-BN is the
+strictly better-behaved variant, so we adopt it deliberately.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dexiraft_tpu.config import RAFTConfig, TrainConfig
+from dexiraft_tpu.models.raft import RAFT
+from dexiraft_tpu.ops.losses import sequence_loss
+from dexiraft_tpu.parallel.mesh import batch_sharding, replicated_sharding
+from dexiraft_tpu.train.optimizer import training_schedule
+from dexiraft_tpu.train.state import TrainState, make_optimizer_from
+
+Batch = Dict[str, jax.Array]  # image1, image2, flow, valid [, edges1, edges2]
+
+
+def _add_noise(rng: jax.Array, stdv: jax.Array, image: jax.Array) -> jax.Array:
+    """Gaussian noise at the given stdv, clipped to [0,255] (train.py:170-173);
+    the reference draws ONE stdv ~ U(0,5) shared by both frames."""
+    noisy = image + stdv * jax.random.normal(rng, image.shape, jnp.float32)
+    return jnp.clip(noisy, 0.0, 255.0)
+
+
+def make_train_step(
+    cfg: RAFTConfig,
+    tc: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build the jitted train step. With a mesh, in/out shardings pin the
+    batch to the 'data' axis and everything else replicated."""
+    model = RAFT(cfg)
+    tx = make_optimizer_from(tc)
+    schedule = training_schedule(tc.lr, tc.num_steps)
+
+    def loss_fn(params: Any, state: TrainState, batch: Batch, rng: jax.Array):
+        kwargs: Dict[str, Any] = {}
+        if "edges1" in batch:
+            kwargs = dict(edges1=batch["edges1"], edges2=batch["edges2"])
+        outputs, mutated = model.apply(
+            {"params": params, "batch_stats": state.batch_stats},
+            batch["image1"],
+            batch["image2"],
+            iters=tc.iters,
+            train=True,
+            freeze_bn=tc.freeze_bn,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+            **kwargs,
+        )
+        loss, metrics = sequence_loss(outputs, batch["flow"], batch["valid"], tc.gamma)
+        return loss, (metrics, mutated.get("batch_stats", state.batch_stats))
+
+    def step(state: TrainState, batch: Batch):
+        rng, noise_rng, dropout_rng = jax.random.split(state.rng, 3)
+        if tc.add_noise:
+            k_stdv, k1, k2 = jax.random.split(noise_rng, 3)
+            stdv = jax.random.uniform(k_stdv, (), jnp.float32, 0.0, 5.0)
+            batch = dict(batch)
+            batch["image1"] = _add_noise(k1, stdv, batch["image1"])
+            batch["image2"] = _add_noise(k2, stdv, batch["image2"])
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (metrics, batch_stats)), grads = grad_fn(
+            state.params, state, batch, dropout_rng
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+            rng=rng,
+        )
+        metrics = dict(metrics, loss=loss, lr=schedule(state.step))
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+
+    repl = replicated_sharding(mesh)
+    data = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(repl, data),
+        out_shardings=(repl, repl),
+        donate_argnums=0,
+    )
+
+
+def make_eval_step(
+    cfg: RAFTConfig,
+    iters: int = 24,
+    mesh: Optional[Mesh] = None,
+) -> Callable[..., Tuple[jax.Array, jax.Array]]:
+    """Jitted test-mode forward: (flow_low, flow_up) like core/raft.py:194-197.
+
+    flow_init enables warm-start submission inference (evaluate.py:40-44).
+    With a mesh, shard inputs on the caller side (parallel.shard_batch) —
+    jit propagates input shardings, so no in_shardings pinning is needed
+    and optional args (edges, flow_init) stay supported.
+    """
+    del mesh  # sharding follows the inputs; kept for API symmetry
+    model = RAFT(cfg)
+
+    def step(
+        variables: Dict[str, Any],
+        image1: jax.Array,
+        image2: jax.Array,
+        edges1: Optional[jax.Array] = None,
+        edges2: Optional[jax.Array] = None,
+        flow_init: Optional[jax.Array] = None,
+    ):
+        kwargs: Dict[str, Any] = {}
+        if edges1 is not None:
+            kwargs = dict(edges1=edges1, edges2=edges2)
+        return model.apply(
+            variables,
+            image1,
+            image2,
+            iters=iters,
+            flow_init=flow_init,
+            train=False,
+            test_mode=True,
+            **kwargs,
+        )
+
+    return jax.jit(step)
