@@ -1,0 +1,75 @@
+"""Benchmark harness — the north-star metric.
+
+Measures refinement iters/sec/chip for the flagship v5 Dexi-RAFT at the
+Sintel eval resolution 436x1024 (padded to 440x1024, InputPadder contract),
+test-mode forward with 32 refinement iterations — the configuration of
+BASELINE.json ("refinement iters/sec/chip at 436x1024") and of
+validate_sintel in the reference (evaluate.py:102-133, iters=32).
+
+The reference records NO throughput numbers (BASELINE.md); vs_baseline is
+computed against an estimated 320 refinement iters/sec for the reference's
+CUDA path on a single modern GPU (upstream RAFT reports ~10 FPS at
+1024x436 with 32 iters; 10*32=320). That estimate is carried in
+BASELINE_ITERS_PER_SEC below so the driver's record is reproducible.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_ITERS_PER_SEC = 320.0
+ITERS = 32
+HEIGHT, WIDTH = 440, 1024  # 436 padded to /8 (core/utils/utils.py:7-19)
+
+
+def main() -> None:
+    from dexiraft_tpu.config import raft_v5
+    from dexiraft_tpu.models.raft import RAFT
+
+    platform = jax.devices()[0].platform
+    # The materialized all-pairs volume at this resolution is (55*128)^2 fp32
+    # per stream; the memory-efficient local path is the bench target once
+    # wired (mirrors the reference benching alt_cuda_corr). Until then bench
+    # allpairs — it fits v5e HBM at batch 1.
+    cfg = raft_v5(mixed_precision=(platform == "tpu"))
+    model = RAFT(cfg)
+
+    rng = jax.random.PRNGKey(0)
+    small = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = model.init(rng, small, small, iters=1, train=False)
+
+    @jax.jit
+    def forward(image1, image2):
+        return model.apply(variables, image1, image2, iters=ITERS,
+                           train=False, test_mode=True)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    image1 = jax.random.uniform(k1, (1, HEIGHT, WIDTH, 3), jnp.float32, 0, 255)
+    image2 = jax.random.uniform(k2, (1, HEIGHT, WIDTH, 3), jnp.float32, 0, 255)
+
+    # compile + warmup
+    jax.block_until_ready(forward(image1, image2))
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(forward(image1, image2))
+    dt = (time.perf_counter() - t0) / reps
+
+    iters_per_sec = ITERS / dt
+    print(json.dumps({
+        "metric": f"refinement_iters_per_sec_per_chip@{HEIGHT}x{WIDTH}",
+        "value": round(iters_per_sec, 2),
+        "unit": "iters/s",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
