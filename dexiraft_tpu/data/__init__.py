@@ -1,0 +1,51 @@
+"""Host-side data pipeline: file I/O, datasets, augmentation, loading.
+
+TPU-first re-design of the reference's torch DataLoader stack
+(core/datasets.py, core/utils/augmentor.py, core/utils/frame_utils.py):
+pure numpy samples with explicit PRNG, per-host sharded batches, and a
+threaded prefetcher that keeps the chips fed.
+"""
+
+from dexiraft_tpu.data.augment import ColorJitter, FlowAugmentor, SparseFlowAugmentor
+from dexiraft_tpu.data.datasets import (
+    HD1K,
+    KITTI,
+    EdgePairDataset,
+    FlowDataset,
+    FlyingChairs,
+    FlyingThings3D,
+    MpiSintel,
+    fetch_dataset,
+)
+from dexiraft_tpu.data.flow_io import (
+    read_flo,
+    read_flow_kitti,
+    read_gen,
+    read_pfm,
+    write_flo,
+    write_flow_kitti,
+)
+from dexiraft_tpu.data.loader import Loader
+from dexiraft_tpu.data.padder import InputPadder
+
+__all__ = [
+    "ColorJitter",
+    "FlowAugmentor",
+    "SparseFlowAugmentor",
+    "FlowDataset",
+    "EdgePairDataset",
+    "FlyingChairs",
+    "FlyingThings3D",
+    "MpiSintel",
+    "KITTI",
+    "HD1K",
+    "fetch_dataset",
+    "read_flo",
+    "write_flo",
+    "read_pfm",
+    "read_flow_kitti",
+    "write_flow_kitti",
+    "read_gen",
+    "Loader",
+    "InputPadder",
+]

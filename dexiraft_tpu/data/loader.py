@@ -1,0 +1,128 @@
+"""Batch loader: deterministic shuffle, per-host sharding, threaded prefetch.
+
+Replaces torch's DataLoader (core/datasets.py:233-234: bs, shuffle,
+4 workers, drop_last). TPU-first:
+  * the global batch is SPLIT ACROSS HOSTS — each process decodes only its
+    jax.process_index() slice, the device_put in parallel.shard_batch does
+    the rest (multi-host DP without any data duplication);
+  * shuffling and augmentation are driven by counter-based PRNG streams
+    keyed on (seed, epoch, global index) — any sample of any epoch is
+    reproducible in isolation, unlike the reference's per-worker seeding;
+  * a thread pool decodes ahead of the training step (the chips, not the
+    host, should be the bottleneck). The optional C++ decode path plugs in
+    below this layer (dexiraft_tpu.data.native).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+def _stack(samples) -> Batch:
+    keys = [k for k in samples[0] if k != "extra_info"]
+    return {k: np.stack([s[k] for s in samples]) for k in keys}
+
+
+class Loader:
+    """Iterable over batches of a FlowDataset(-like) object.
+
+    len(dataset) defines an epoch; iteration is endless (the trainer's
+    should_keep_training loop decides when to stop, train.py:163).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 1234,
+        num_workers: int = 4,
+        prefetch: int = 4,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        if batch_size % process_count:
+            raise ValueError(
+                f"global batch {batch_size} must divide over {process_count} hosts")
+        self.dataset = dataset
+        self.global_batch = batch_size
+        self.local_batch = batch_size // process_count
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.prefetch = prefetch
+        self.process_index = process_index
+        self.process_count = process_count
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.global_batch
+        if not self.drop_last and len(self.dataset) % self.global_batch:
+            n += 1
+        return n
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        return order
+
+    def _decode(self, epoch: int, index: int) -> Batch:
+        rng = np.random.default_rng((self.seed, epoch, index))
+        return self.dataset.sample(int(index), rng)
+
+    def batches(self, start_epoch: int = 0) -> Iterator[Batch]:
+        """Endless batch stream; this host's slice of each global batch."""
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        out: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        # a trailing partial global batch cannot be split evenly across
+        # hosts — some would yield one more batch than others and the
+        # sharded step's collectives would deadlock; always drop it when
+        # multi-host
+        drop_last = self.drop_last or self.process_count > 1
+
+        def submit_loop():
+            epoch = start_epoch
+            while not stop.is_set():
+                order = self._epoch_order(epoch)
+                usable = (len(order) // self.global_batch * self.global_batch
+                          if drop_last else len(order))
+                for b0 in range(0, usable, self.global_batch):
+                    lo = b0 + self.process_index * self.local_batch
+                    ids = order[lo:lo + self.local_batch]
+                    if len(ids) == 0:
+                        continue
+                    futs = [pool.submit(self._decode, epoch, i) for i in ids]
+                    while not stop.is_set():  # never park forever on put
+                        try:
+                            out.put(futs, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                epoch += 1
+
+        feeder = threading.Thread(target=submit_loop, daemon=True)
+        feeder.start()
+        try:
+            while True:
+                futs = out.get()
+                yield _stack([f.result() for f in futs])
+        finally:
+            stop.set()
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self.batches()

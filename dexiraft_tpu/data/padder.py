@@ -1,0 +1,34 @@
+"""Eval-time padding to stride-8 shapes (core/utils/utils.py:7-24).
+
+'sintel' mode centers the pad; other modes (kitti/HD1K) pad top+right only
+— replicate-edge padding in both, like F.pad(mode='replicate').
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+class InputPadder:
+    def __init__(self, shape: Sequence[int], mode: str = "sintel", stride: int = 8):
+        self.ht, self.wd = int(shape[-3]), int(shape[-2])  # NHWC
+        pad_ht = (((self.ht // stride) + 1) * stride - self.ht) % stride
+        pad_wd = (((self.wd // stride) + 1) * stride - self.wd) % stride
+        if mode == "sintel":
+            # [left, right, top, bottom]
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2]
+        else:
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
+
+    def pad(self, *inputs: np.ndarray) -> Tuple[np.ndarray, ...]:
+        l, r, t, b = self._pad
+        width = [(0, 0)] * (inputs[0].ndim - 3) + [(t, b), (l, r), (0, 0)]
+        return tuple(np.pad(x, width, mode="edge") for x in inputs)
+
+    def unpad(self, x: np.ndarray) -> np.ndarray:
+        l, r, t, b = self._pad
+        ht, wd = x.shape[-3], x.shape[-2]
+        return x[..., t:ht - b or None, l:wd - r or None, :]
