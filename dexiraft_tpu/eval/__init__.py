@@ -1,0 +1,25 @@
+"""Evaluation: dataset-metric validators, benchmark submissions, flow viz."""
+
+from dexiraft_tpu.eval.flow_viz import flow_to_image
+from dexiraft_tpu.eval.interpolate import forward_interpolate
+from dexiraft_tpu.eval.validate import (
+    validate_chairs,
+    validate_hd1k,
+    validate_kitti,
+    validate_sintel,
+)
+from dexiraft_tpu.eval.submission import (
+    create_kitti_submission,
+    create_sintel_submission,
+)
+
+__all__ = [
+    "flow_to_image",
+    "forward_interpolate",
+    "validate_chairs",
+    "validate_sintel",
+    "validate_kitti",
+    "validate_hd1k",
+    "create_sintel_submission",
+    "create_kitti_submission",
+]

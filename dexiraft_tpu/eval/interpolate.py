@@ -1,0 +1,67 @@
+"""Warm-start flow propagation, fully on-device.
+
+The reference's forward_interpolate (core/utils/utils.py:26-54) splats the
+previous frame's low-res flow forward and re-grids it with scipy
+griddata(nearest) — a device->host->device round-trip per frame in the
+submission loop (evaluate.py:43-44, SURVEY.md §3.3).
+
+Here the splat is a scatter on device and holes are filled by iterated
+masked 3x3 averaging (a chamfer-style approximation of nearest-neighbor
+fill; documented divergence — hole values are local means rather than
+exact nearest, which only seeds the next frame's refinement).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _box3(x: jax.Array) -> jax.Array:
+    """3x3 box sum over (H, W, C)."""
+    return jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (3, 3, 1), (1, 1, 1), "SAME"
+    )
+
+
+@partial(jax.jit, static_argnames="max_fill_iters")
+def forward_interpolate(flow: jax.Array, max_fill_iters: int = 64) -> jax.Array:
+    """Propagate (H, W, 2) flow to the next frame's grid.
+
+    Each pixel's flow vector is carried to its rounded target location;
+    unreached pixels are filled by repeated masked dilation.
+    """
+    h, w = flow.shape[:2]
+    ys, xs = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+    x1 = xs + flow[..., 0]
+    y1 = ys + flow[..., 1]
+    xi = jnp.round(x1).astype(jnp.int32)
+    yi = jnp.round(y1).astype(jnp.int32)
+    inside = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    # out-of-frame points get an out-of-range index -> dropped by the scatter
+    lin = jnp.where(inside, yi * w + xi, h * w)
+
+    splat = jnp.zeros((h * w, 2), jnp.float32).at[lin.ravel()].set(
+        flow.reshape(-1, 2), mode="drop")
+    mask = jnp.zeros((h * w, 1), jnp.float32).at[lin.ravel()].set(
+        1.0, mode="drop")
+    splat = splat.reshape(h, w, 2)
+    mask = mask.reshape(h, w, 1)
+
+    def fill_cond(state):
+        i, _, m = state
+        return (i < max_fill_iters) & jnp.any(m < 0.5)
+
+    def fill_body(state):
+        i, f, m = state
+        cnt = _box3(m)
+        avg = _box3(f * m) / jnp.maximum(cnt, 1.0)
+        f = jnp.where(m > 0.5, f, avg)
+        m = jnp.maximum(m, jnp.minimum(cnt, 1.0))
+        return i + 1, f, m
+
+    _, filled, _ = jax.lax.while_loop(
+        fill_cond, fill_body, (jnp.int32(0), splat, mask))
+    return filled

@@ -1,0 +1,126 @@
+"""Dataset-metric validators (evaluate.py:81-210).
+
+Each validator consumes `eval_fn(image1, image2) -> (flow_low, flow_up)`
+— a jitted test-mode forward built with the reference iteration counts
+(chairs/kitti 24, sintel 32) via dexiraft_tpu.train.step.make_eval_step —
+and a dataset, and returns the reference's metric dict. Batch size is 1
+per frame pair, matching the reference's eval loops; metrics accumulate
+in numpy on host.
+
+validate_hd1k fixes the reference's undefined-variable crash
+(evaluate.py:197 references valid_gt that was never read) by actually
+using the dataset's sparse valid mask.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from dexiraft_tpu.data.padder import InputPadder
+
+EvalFn = Callable[..., Tuple[np.ndarray, np.ndarray]]
+
+
+def _epe(pred: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    return np.sqrt(np.sum((pred - gt) ** 2, axis=-1))
+
+
+def _run(eval_fn: EvalFn, img1: np.ndarray, img2: np.ndarray,
+         mode: str) -> np.ndarray:
+    """Pad -> forward -> unpad; returns (H, W, 2) upsampled flow."""
+    padder = InputPadder(img1.shape, mode=mode)
+    p1, p2 = padder.pad(img1[None], img2[None])
+    _, flow_up = eval_fn(p1, p2)
+    return np.asarray(padder.unpad(np.asarray(flow_up)))[0]
+
+
+def validate_chairs(eval_fn: EvalFn, dataset=None) -> Dict[str, float]:
+    """FlyingChairs val EPE (evaluate.py:81-98; iters=24 in the caller)."""
+    if dataset is None:
+        from dexiraft_tpu.data.datasets import FlyingChairs
+        dataset = FlyingChairs(None, split="validation")
+    epe_all = []
+    for i in range(len(dataset)):
+        s = dataset.sample(i)
+        flow = _run(eval_fn, s["image1"], s["image2"], "sintel")
+        epe_all.append(_epe(flow, s["flow"]).ravel())
+    epe = float(np.concatenate(epe_all).mean())
+    print(f"Validation Chairs EPE: {epe:.3f}")
+    return {"chairs": epe}
+
+
+def validate_sintel(eval_fn: EvalFn, datasets=None) -> Dict[str, float]:
+    """Sintel train-split clean+final EPE / px accuracies (evaluate.py:102-133)."""
+    if datasets is None:
+        from dexiraft_tpu.data.datasets import MpiSintel
+        datasets = {d: MpiSintel(None, split="training", dstype=d)
+                    for d in ("clean", "final")}
+    results: Dict[str, float] = {}
+    for dstype, ds in datasets.items():
+        epe_all = []
+        for i in range(len(ds)):
+            s = ds.sample(i)
+            flow = _run(eval_fn, s["image1"], s["image2"], "sintel")
+            epe_all.append(_epe(flow, s["flow"]).ravel())
+        epe = np.concatenate(epe_all)
+        results[dstype] = float(epe.mean())
+        results[f"{dstype}_px1"] = float((epe < 1).mean())
+        results[f"{dstype}_px3"] = float((epe < 3).mean())
+        results[f"{dstype}_px5"] = float((epe < 5).mean())
+        print(f"Validation ({dstype}) EPE: {results[dstype]:.3f}, "
+              f"1px: {results[f'{dstype}_px1']:.3f}, "
+              f"3px: {results[f'{dstype}_px3']:.3f}, "
+              f"5px: {results[f'{dstype}_px5']:.3f}")
+    return results
+
+
+def _sparse_metrics(eval_fn: EvalFn, dataset, mode: str) -> Tuple[float, float]:
+    """Sparse EPE over valid pixels + F1 (= % of valid pixels with epe>3
+    AND epe/mag>5%, the KITTI outlier definition, evaluate.py:158-166)."""
+    epe_list, out_list = [], []
+    for i in range(len(dataset)):
+        s = dataset.sample(i)
+        flow = _run(eval_fn, s["image1"], s["image2"], mode)
+        epe = _epe(flow, s["flow"]).ravel()
+        mag = np.sqrt(np.sum(s["flow"] ** 2, axis=-1)).ravel()
+        val = s["valid"].ravel() >= 0.5
+        out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
+        epe_list.append(epe[val].mean())
+        out_list.append(out[val])
+    return (float(np.mean(epe_list)),
+            100.0 * float(np.concatenate(out_list).mean()))
+
+
+def validate_kitti(eval_fn: EvalFn, dataset=None) -> Dict[str, float]:
+    """KITTI-15 train-split EPE + F1 (evaluate.py:137-172; iters=24)."""
+    if dataset is None:
+        from dexiraft_tpu.data.datasets import KITTI
+        dataset = KITTI(None, split="training")
+    epe, f1 = _sparse_metrics(eval_fn, dataset, "kitti")
+    print(f"Validation KITTI: {epe:.3f}, {f1:.3f}")
+    return {"kitti-epe": epe, "kitti-f1": f1}
+
+
+def validate_hd1k(eval_fn: EvalFn, dataset=None) -> Dict[str, float]:
+    """HD1K sparse EPE + F1 — the reference's version crashes on an
+    undefined variable (evaluate.py:197); fixed here."""
+    if dataset is None:
+        from dexiraft_tpu.data.datasets import HD1K
+        dataset = HD1K(None)
+    epe, f1 = _sparse_metrics(eval_fn, dataset, "kitti")
+    print(f"Validation HD1K: {epe:.3f}, {f1:.3f}")
+    return {"hd1k-epe": epe, "hd1k-f1": f1}
+
+
+VALIDATORS = {
+    "chairs": validate_chairs,
+    "sintel": validate_sintel,
+    "kitti": validate_kitti,
+    "hd1k": validate_hd1k,
+}
+
+
+def run_validation(name: str, eval_fn: EvalFn, dataset=None) -> Dict[str, float]:
+    return VALIDATORS[name](eval_fn, dataset)

@@ -1,0 +1,149 @@
+"""Eval layer tests: flow viz, warm-start interpolation, validators,
+submission writers — all on synthetic data with stub eval functions."""
+
+import numpy as np
+
+from dexiraft_tpu.data.flow_io import read_flo, read_flow_kitti
+from dexiraft_tpu.eval import (
+    create_kitti_submission,
+    create_sintel_submission,
+    flow_to_image,
+    forward_interpolate,
+    validate_chairs,
+    validate_kitti,
+)
+
+
+class _StubDense:
+    """Dense dataset stub: ground-truth flow is constant (2, -1)."""
+
+    def __init__(self, n=3, h=60, w=80):
+        self.n, self.h, self.w = n, h, w
+
+    def __len__(self):
+        return self.n
+
+    def sample(self, i, rng=None):
+        r = np.random.default_rng(i)
+        return {
+            "image1": r.uniform(0, 255, (self.h, self.w, 3)).astype(np.float32),
+            "image2": r.uniform(0, 255, (self.h, self.w, 3)).astype(np.float32),
+            "flow": np.broadcast_to(np.float32([2.0, -1.0]),
+                                    (self.h, self.w, 2)).copy(),
+            "valid": np.ones((self.h, self.w), np.float32),
+        }
+
+
+def _perfect_eval_fn(im1, im2, flow_init=None):
+    """Predicts exactly (2, -1) everywhere."""
+    b, h, w = im1.shape[:3]
+    up = np.broadcast_to(np.float32([2.0, -1.0]), (b, h, w, 2)).copy()
+    low = np.broadcast_to(np.float32([0.25, -0.125]),
+                          (b, h // 8, w // 8, 2)).copy()
+    return low, up
+
+
+class TestFlowViz:
+    def test_shapes_and_dtype(self):
+        flow = np.random.default_rng(0).normal(size=(32, 48, 2)).astype(np.float32)
+        img = flow_to_image(flow)
+        assert img.shape == (32, 48, 3) and img.dtype == np.uint8
+
+    def test_zero_flow_is_white(self):
+        img = flow_to_image(np.zeros((8, 8, 2), np.float32))
+        assert (img > 250).all()  # zero magnitude -> center of wheel (white)
+
+    def test_bgr_swaps_channels(self):
+        flow = np.random.default_rng(1).normal(size=(8, 8, 2)).astype(np.float32)
+        rgb = flow_to_image(flow)
+        bgr = flow_to_image(flow, convert_to_bgr=True)
+        np.testing.assert_array_equal(rgb[..., 0], bgr[..., 2])
+
+
+class TestForwardInterpolate:
+    def test_zero_flow_identity(self):
+        flow = np.zeros((16, 20, 2), np.float32)
+        out = np.asarray(forward_interpolate(flow))
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_constant_flow_fills_everywhere(self):
+        # every pixel moves +4 in x: splat covers x>=4, holes filled left
+        flow = np.zeros((16, 20, 2), np.float32)
+        flow[..., 0] = 4.0
+        out = np.asarray(forward_interpolate(flow))
+        np.testing.assert_allclose(out, np.broadcast_to([4.0, 0.0], out.shape),
+                                   atol=1e-5)
+
+    def test_out_of_frame_vectors_dropped(self):
+        flow = np.full((8, 8, 2), 100.0, np.float32)  # all leave the frame
+        out = np.asarray(forward_interpolate(flow))
+        np.testing.assert_allclose(out, 0.0)  # nothing splatted -> zeros
+
+
+class TestValidators:
+    def test_chairs_perfect(self):
+        res = validate_chairs(_perfect_eval_fn, dataset=_StubDense())
+        assert res["chairs"] < 1e-5
+
+    def test_chairs_known_error(self):
+        def off_by_one(im1, im2, flow_init=None):
+            low, up = _perfect_eval_fn(im1, im2)
+            return low, up + np.float32([1.0, 0.0])
+
+        res = validate_chairs(off_by_one, dataset=_StubDense())
+        np.testing.assert_allclose(res["chairs"], 1.0, atol=1e-5)
+
+    def test_kitti_f1_counts_outliers(self):
+        class SparseStub(_StubDense):
+            def __init__(self):
+                super().__init__(n=3, h=64, w=80)  # stride-8: no pad shift
+
+            def sample(self, i, rng=None):
+                s = super().sample(i, rng)
+                # large GT so epe/mag stays under 5% for inliers
+                s["flow"] = np.broadcast_to(np.float32([90.0, 0.0]),
+                                            (self.h, self.w, 2)).copy()
+                s["valid"] = np.ones((self.h, self.w), np.float32)
+                return s
+
+        def half_outliers(im1, im2, flow_init=None):
+            b, h, w = im1.shape[:3]
+            up = np.broadcast_to(np.float32([90.0, 0.0]), (b, h, w, 2)).copy()
+            up[:, : h // 2] += np.float32([20.0, 0.0])  # epe 20 > 3, ratio .22
+            return _perfect_eval_fn(im1, im2)[0], up
+
+        res = validate_kitti(half_outliers, dataset=SparseStub())
+        np.testing.assert_allclose(res["kitti-f1"], 50.0, atol=1.0)
+
+
+class TestSubmissions:
+    def test_sintel_submission_tree(self, tmp_path):
+        class SintelStub(_StubDense):
+            def sample(self, i, rng=None):
+                s = super().sample(i, rng)
+                s["extra_info"] = ("alley_1", i)
+                return {"image1": s["image1"], "image2": s["image2"],
+                        "extra_info": s["extra_info"]}
+
+        out = tmp_path / "sub"
+        create_sintel_submission(_perfect_eval_fn, output_path=str(out),
+                                 warm_start=True,
+                                 datasets={"clean": SintelStub(n=2)})
+        f = out / "clean" / "alley_1" / "frame0001.flo"
+        assert f.exists()
+        flow = read_flo(f)
+        np.testing.assert_allclose(flow[..., 0], 2.0, atol=1e-5)
+
+    def test_kitti_submission_pngs(self, tmp_path):
+        class KittiStub(_StubDense):
+            def sample(self, i, rng=None):
+                s = super().sample(i, rng)
+                return {"image1": s["image1"], "image2": s["image2"],
+                        "extra_info": [f"{i:06d}_10.png"]}
+
+        out = tmp_path / "kitti"
+        create_kitti_submission(_perfect_eval_fn, output_path=str(out),
+                                dataset=KittiStub(n=2))
+        flow, valid = read_flow_kitti(out / "000000_10.png")
+        np.testing.assert_allclose(flow[..., 0], 2.0, atol=1 / 64)
+        assert valid.min() == 1.0
