@@ -32,7 +32,10 @@ class RAFTConfig:
     corr_radius: Optional[int] = None  # None -> 4 full / 3 small (core/raft.py:37-47)
     dropout: float = 0.0
     mixed_precision: bool = False  # bf16 compute in encoders/update; corr stays fp32
-    corr_impl: str = "allpairs"  # allpairs | local (on-demand, memory-efficient)
+    corr_impl: str = "allpairs"  # allpairs | local | pallas (on-demand paths)
+    # rows per chunk for the local path's gather (bounds the transient
+    # patch buffer to rows*W*(2r+2)^2*C floats; None = whole frame at once)
+    corr_row_chunk: Optional[int] = 8
 
     @property
     def radius(self) -> int:

@@ -35,6 +35,7 @@ from dexiraft_tpu.models.dexined import DexiNed, stack_edge_maps
 from dexiraft_tpu.models.extractor import BasicEncoder, SmallEncoder
 from dexiraft_tpu.models.update import BasicUpdateBlock, RefineFlow, SmallUpdateBlock
 from dexiraft_tpu.ops.corr import build_corr_pyramid
+from dexiraft_tpu.ops.local_corr import build_local_corr
 from dexiraft_tpu.ops.grid import coords_grid, upflow8
 from dexiraft_tpu.ops.upsample import upsample_flow_convex
 
@@ -132,11 +133,8 @@ class RAFT(nn.Module):
         or (flow_low, flow_up) in test_mode (core/raft.py:194-197).
         """
         cfg = self.cfg
-        if cfg.corr_impl != "allpairs":
-            raise NotImplementedError(
-                f"corr_impl={cfg.corr_impl!r} is not wired up yet; only "
-                "'allpairs' (materialized volume) is available"
-            )
+        if cfg.corr_impl not in ("allpairs", "local", "pallas"):
+            raise ValueError(f"unknown corr_impl {cfg.corr_impl!r}")
         if cfg.variant == "dual" and not cfg.embed_dexined:
             raise ValueError(
                 "variant='dual' requires embed_dexined=True (the v5 edge "
@@ -178,10 +176,19 @@ class RAFT(nn.Module):
         fnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype, name="fnet")
         cnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype, name="cnet")
 
+        def build_pyr(f1, f2):
+            # plugin seam (BASELINE.json): materialized MXU volume vs
+            # on-demand local correlation (the alt_cuda_corr analog)
+            if cfg.corr_impl == "allpairs":
+                return build_corr_pyramid(f1, f2, cfg.corr_levels, cfg.radius)
+            return build_local_corr(f1, f2, cfg.corr_levels, cfg.radius,
+                                    row_chunk=cfg.corr_row_chunk,
+                                    use_pallas=cfg.corr_impl == "pallas")
+
         fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
                             train=train, bn_train=bn_train)
         fmap1, fmap2 = fmap1.astype(jnp.float32), fmap2.astype(jnp.float32)
-        pyr = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels, cfg.radius)
+        pyr = build_pyr(fmap1, fmap2)
 
         ctx = cnet(image1.astype(dtype), train=train, bn_train=bn_train)
         net = jnp.tanh(ctx[..., :hdim])
@@ -206,7 +213,7 @@ class RAFT(nn.Module):
             fem1, fem2 = efnet((em1.astype(dtype), em2.astype(dtype)),
                                train=train, bn_train=bn_train)
             fem1, fem2 = fem1.astype(jnp.float32), fem2.astype(jnp.float32)
-            epyr = build_corr_pyramid(fem1, fem2, cfg.corr_levels, cfg.radius)
+            epyr = build_pyr(fem1, fem2)
             ectx = ecnet(em1.astype(dtype), train=train, bn_train=bn_train)
             carry.update(
                 ecoords1=coords_grid(b, h8, w8),

@@ -1,0 +1,181 @@
+"""Memory-efficient local correlation — the alt_cuda_corr equivalent.
+
+The reference's CUDA kernel (alt_cuda_corr/correlation_kernel.cu:19-119)
+computes, per query pixel, dot products of fmap1 against an integer
+lattice of fmap2 rows around floor(coords) and scatter-accumulates the 4
+bilinear corner weights into a (2r+1)^2 window. O(HW * (2r+2)^2) memory
+instead of the materialized volume's O((HW)^2) (SURVEY.md §2.2).
+
+TPU-native reformulation (gather, not scatter):
+  1. gather the (2r+2)^2 integer patch of fmap2 around floor(coords)
+     (XLA gather HLO — the embedding-lookup path, HBM-bandwidth bound);
+  2. one batched einsum against fmap1 for the integer-lattice dots;
+  3. blend the 4 corners on the VPU: window[j] = sum_c w_c * lattice[j + c]
+     — the exact transpose of the CUDA kernel's scatter.
+
+Like the reference's AlternateCorrBlock (core/corr.py:63-91), the pyramid
+pools FMAP2 (not the correlation volume), so numerics differ slightly
+from the materialized path at levels > 0 — the same approximation the
+reference makes. Out-of-frame lattice points contribute zero, matching
+bilinear_sampler's zero padding.
+
+Gradients flow to fmap1/fmap2 through the gather/einsum; coords get zero
+gradient (stop_gradient), replicating the CUDA backward's never-written
+coords_grad (correlation_kernel.cu:307). The reference's Python wrapper
+has NO autograd at all (core/corr.py:86 calls the op directly) — ours is
+trainable, a strict capability superset.
+
+Row-chunking (lax.map over row blocks) bounds the transient patch buffer:
+full-frame Sintel eval would otherwise materialize
+HW * (2r+2)^2 * C * 4B ≈ 720 MB per level.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.ops.corr import avg_pool_2x2
+
+
+def local_corr_level(
+    fmap1: jax.Array,
+    fmap2: jax.Array,
+    coords: jax.Array,
+    radius: int,
+    row_chunk: Optional[int] = None,
+) -> jax.Array:
+    """Windowed correlation of fmap1 against fmap2 around coords.
+
+    fmap1: (B, H, W, C) query features (level-0 resolution)
+    fmap2: (B, H2, W2, C) target features at this pyramid level
+    coords: (B, H, W, 2) sample centers in LEVEL pixels (x, y)
+    Returns (B, H, W, (2r+1)^2) float32.
+    """
+    b, h, w, c = fmap1.shape
+    coords = jax.lax.stop_gradient(coords)
+
+    if row_chunk is not None and row_chunk < h:
+        pad = (-h) % row_chunk
+        f1 = jnp.pad(fmap1, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        co = jnp.pad(coords, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        n_chunks = (h + pad) // row_chunk
+        f1 = f1.reshape(b, n_chunks, row_chunk, w, c).swapaxes(0, 1)
+        co = co.reshape(b, n_chunks, row_chunk, w, 2).swapaxes(0, 1)
+        out = jax.lax.map(
+            lambda args: _local_corr_dense(args[0], fmap2, args[1], radius),
+            (f1, co),
+        )  # (n_chunks, B, row_chunk, W, win^2)
+        out = out.swapaxes(0, 1).reshape(b, h + pad, w, -1)
+        return out[:, :h]
+    return _local_corr_dense(fmap1, fmap2, coords, radius)
+
+
+def _local_corr_dense(
+    fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array, radius: int
+) -> jax.Array:
+    b, h, w, c = fmap1.shape
+    h2, w2 = fmap2.shape[1:3]
+    r = radius
+    k = 2 * r + 2  # integer lattice extent (window + 1 for bilinear)
+
+    x = coords[..., 0].astype(jnp.float32)
+    y = coords[..., 1].astype(jnp.float32)
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    fx = (x - x0)[..., None, None]  # (B, H, W, 1, 1)
+    fy = (y - y0)[..., None, None]
+
+    offs = jnp.arange(-r, r + 2, dtype=jnp.int32)  # (k,)
+    xs = x0.astype(jnp.int32)[..., None] + offs  # (B, H, W, k)
+    ys = y0.astype(jnp.int32)[..., None] + offs
+
+    vx = (xs >= 0) & (xs < w2)
+    vy = (ys >= 0) & (ys < h2)
+    xs_c = jnp.clip(xs, 0, w2 - 1)
+    ys_c = jnp.clip(ys, 0, h2 - 1)
+
+    # (B, H, W, k, k) flat indices into fmap2's H2*W2 axis: [ky, kx]
+    lin = ys_c[..., :, None] * w2 + xs_c[..., None, :]
+    valid = (vy[..., :, None] & vx[..., None, :]).astype(jnp.float32)
+
+    f2 = fmap2.reshape(b, h2 * w2, c)
+    patches = jnp.take_along_axis(
+        f2[:, None, :, :],
+        lin.reshape(b, 1, h * w * k * k, 1),
+        axis=2,
+    ).reshape(b, h, w, k, k, c)
+
+    # integer-lattice dot products, fp32 accumulate (MXU)
+    lattice = jnp.einsum(
+        "bhwc,bhwijc->bhwij",
+        fmap1.astype(jnp.float32),
+        patches.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    lattice = lattice * valid / jnp.sqrt(jnp.float32(c))
+
+    # bilinear corner blend: out[j] = sum_{cy,cx} w * lattice[j+cy, j+cx]
+    win = 2 * r + 1
+    tl = lattice[..., 0:win, 0:win]
+    tr = lattice[..., 0:win, 1:win + 1]
+    bl = lattice[..., 1:win + 1, 0:win]
+    br = lattice[..., 1:win + 1, 1:win + 1]
+    out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
+           + fy * (1 - fx) * bl + fy * fx * br)
+    return out.reshape(b, h, w, win * win)
+
+
+@flax.struct.dataclass
+class LocalCorr:
+    """On-demand correlation pyramid: same lookup interface as CorrPyramid.
+
+    Holds fmap1 and the avg-pooled fmap2 pyramid (core/corr.py:64-72);
+    correlation is computed per lookup instead of materialized.
+    """
+
+    fmap1: jax.Array  # (B, H, W, C)
+    fmap2_pyramid: tuple  # tuple of (B, H>>i, W>>i, C)
+    batch: int = flax.struct.field(pytree_node=False)
+    ht: int = flax.struct.field(pytree_node=False)
+    wd: int = flax.struct.field(pytree_node=False)
+    radius: int = flax.struct.field(pytree_node=False)
+    row_chunk: Optional[int] = flax.struct.field(pytree_node=False, default=None)
+    use_pallas: bool = flax.struct.field(pytree_node=False, default=False)
+
+    def __call__(self, coords: jax.Array) -> jax.Array:
+        """coords (B, H, W, 2) in level-0 pixels -> (B, H, W, L*(2r+1)^2)."""
+        out: List[jax.Array] = []
+        for i, f2 in enumerate(self.fmap2_pyramid):
+            coords_i = coords / (2.0 ** i)
+            if self.use_pallas:
+                from dexiraft_tpu.ops.pallas_corr import pallas_local_corr_level
+                corr = pallas_local_corr_level(
+                    self.fmap1, f2, coords_i, self.radius)
+            else:
+                corr = local_corr_level(
+                    self.fmap1, f2, coords_i, self.radius, self.row_chunk)
+            out.append(corr)
+        return jnp.concatenate(out, axis=-1).astype(jnp.float32)
+
+
+def build_local_corr(
+    fmap1: jax.Array,
+    fmap2: jax.Array,
+    num_levels: int = 4,
+    radius: int = 4,
+    row_chunk: Optional[int] = None,
+    use_pallas: bool = False,
+) -> LocalCorr:
+    """Build the pooled-fmap2 pyramid (no volume materialization)."""
+    b, h, w, _ = fmap1.shape
+    f1 = fmap1.astype(jnp.float32)
+    levels = [fmap2.astype(jnp.float32)]
+    for _ in range(num_levels - 1):
+        levels.append(avg_pool_2x2(levels[-1]))
+    return LocalCorr(
+        fmap1=f1, fmap2_pyramid=tuple(levels), batch=b, ht=h, wd=w,
+        radius=radius, row_chunk=row_chunk, use_pallas=use_pallas)

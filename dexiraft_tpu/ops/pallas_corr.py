@@ -1,0 +1,171 @@
+"""Pallas TPU kernel for the local correlation lookup.
+
+The tpu-native twin of alt_cuda_corr/correlation_kernel.cu:19-119, in the
+gather formulation (SURVEY.md §2.2): the CUDA kernel stages fmap tiles
+through __shared__ memory and scatter-accumulates bilinear corner weights;
+here the (zero-padded) fmap2 level lives in VMEM, each grid step owns a
+block of P query pixels, and per pixel we
+
+  1. dynamic-slice the (2r+2, 2r+2, C) integer patch around floor(coords)
+     (VMEM load driven by SMEM-resident scalar indices),
+  2. dot against the pixel's fmap1 row on the VPU (fp32 accumulate),
+  3. mask out-of-frame lattice points (zero-padding semantics of
+     bilinear_sampler / F.grid_sample(zeros)),
+
+then blend the 4 bilinear corners vectorized over the whole block.
+
+Index preparation happens in XLA: coords are clipped to [-r-1, size+r]
+(out-of-range windows are provably all-zero there because the clip bounds
+are integers, so the +1 corner weight vanishes at the boundary), and fmap2
+is zero-padded by 2r+2 so every clipped window is a legal static-size
+slice.
+
+Gradients: forward-only kernel wrapped in jax.custom_vjp; the VJP
+recomputes through the XLA gather formulation (local_corr_level), giving
+fmap gradients and zero coords gradient — the CUDA backward's semantics
+(correlation_kernel.cu:307) without a second hand-written kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dexiraft_tpu.ops.local_corr import local_corr_level
+
+_PIXEL_BLOCK = 256
+
+
+def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
+                 lattice_ref, *, radius: int, h2: int, w2: int):
+    r = radius
+    k = 2 * r + 2
+    win = 2 * r + 1
+    p_block = f1_ref.shape[1]
+    c = f1_ref.shape[2]
+    inv_sqrt_c = 1.0 / (c ** 0.5)
+
+    def body(p, _):
+        sx = sx_ref[0, p]
+        sy = sy_ref[0, p]
+        patch = f2_ref[0, pl.ds(sy, k), pl.ds(sx, k), :]  # (k, k, C)
+        f1p = f1_ref[0, p, :]  # (C,)
+        dots = jnp.sum(
+            patch.astype(jnp.float32) * f1p.astype(jnp.float32)[None, None, :],
+            axis=2,
+        )  # (k, k)
+        # mask lattice points outside the ORIGINAL (unpadded) frame;
+        # slice starts were clipped into the padded frame, so recompute
+        # the true lattice origin: x0 = sx - (r + 2), y0 = sy - (r + 2)
+        gx = jax.lax.broadcasted_iota(jnp.int32, (k, k), 1) + (sx - 2 - 2 * r)
+        gy = jax.lax.broadcasted_iota(jnp.int32, (k, k), 0) + (sy - 2 - 2 * r)
+        valid = ((gx >= 0) & (gx < w2) & (gy >= 0) & (gy < h2))
+        dots = jnp.where(valid, dots * inv_sqrt_c, 0.0)
+        lattice_ref[p, :] = dots.reshape(k * k)
+        return 0
+
+    jax.lax.fori_loop(0, p_block, body, 0)
+
+    lattice = lattice_ref[:].reshape(p_block, k, k)
+    fx = frac_ref[0, :, 0].reshape(p_block, 1, 1)
+    fy = frac_ref[0, :, 1].reshape(p_block, 1, 1)
+    tl = lattice[:, 0:win, 0:win]
+    tr = lattice[:, 0:win, 1:win + 1]
+    bl = lattice[:, 1:win + 1, 0:win]
+    br = lattice[:, 1:win + 1, 1:win + 1]
+    out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
+           + fy * (1 - fx) * bl + fy * fx * br)
+    out_ref[0] = out.reshape(p_block, win * win)
+
+
+def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
+                    radius: int, interpret: bool = False) -> jax.Array:
+    b, h, w, c = fmap1.shape
+    h2, w2 = fmap2.shape[1:3]
+    r = radius
+    k = 2 * r + 2
+    win = 2 * r + 1
+    pad = k  # 2r+2 zeros on every side
+
+    # ---- XLA-side index prep ----
+    x = jnp.clip(coords[..., 0].astype(jnp.float32), -(r + 1.0), w2 - 1 + r + 1.0)
+    y = jnp.clip(coords[..., 1].astype(jnp.float32), -(r + 1.0), h2 - 1 + r + 1.0)
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    frac = jnp.stack([x - x0, y - y0], axis=-1)  # (B, H, W, 2)
+    # slice start in the padded frame: x0 - r + pad = x0 + r + 2, in range
+    # [1, w2 + 2r + 2] given the clip above — always a legal k-slice
+    sx = x0.astype(jnp.int32) + (r + 2)
+    sy = y0.astype(jnp.int32) + (r + 2)
+
+    f2p = jnp.pad(fmap2.astype(jnp.float32),
+                  ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+
+    # flatten pixels, pad to the block size
+    n = h * w
+    n_pad = (-n) % _PIXEL_BLOCK
+    np_tot = n + n_pad
+    flat = lambda a, d: jnp.pad(a.reshape(b, n, *a.shape[3:]),
+                                ((0, 0), (0, n_pad)) + ((0, 0),) * d)
+    f1_flat = flat(fmap1.astype(jnp.float32), 1)
+    sx_flat = flat(sx, 0)  # padded pixels read slice start 0 — harmless
+    sy_flat = flat(sy, 0)
+    frac_flat = flat(frac, 1)
+
+    grid = (b, np_tot // _PIXEL_BLOCK)
+    kernel = functools.partial(_corr_kernel, radius=r, h2=h2, w2=w2)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _PIXEL_BLOCK), lambda bi, ti: (bi, ti),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, _PIXEL_BLOCK), lambda bi, ti: (bi, ti),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, _PIXEL_BLOCK, c), lambda bi, ti: (bi, ti, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h2 + 2 * pad, w2 + 2 * pad, c),
+                         lambda bi, ti: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _PIXEL_BLOCK, 2), lambda bi, ti: (bi, ti, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _PIXEL_BLOCK, win * win),
+                               lambda bi, ti: (bi, ti, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, np_tot, win * win), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_PIXEL_BLOCK, k * k), jnp.float32)],
+        interpret=interpret,
+    )(sx_flat, sy_flat, f1_flat, f2p, frac_flat)
+
+    return out[:, :n].reshape(b, h, w, win * win)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def pallas_local_corr_level(fmap1, fmap2, coords, radius: int,
+                            interpret: bool = False):
+    """(B,H,W,C) x (B,H2,W2,C) x (B,H,W,2 level coords) -> (B,H,W,(2r+1)^2)."""
+    return _pallas_forward(fmap1, fmap2, coords, radius, interpret)
+
+
+def _fwd(fmap1, fmap2, coords, radius, interpret):
+    return (_pallas_forward(fmap1, fmap2, coords, radius, interpret),
+            (fmap1, fmap2, coords))
+
+
+def _bwd(radius, interpret, res, g):
+    fmap1, fmap2, coords = res
+    # row-chunked recompute: bounds the backward's transient patch buffer
+    # the same way the forward XLA path does
+    _, vjp = jax.vjp(
+        lambda f1, f2: local_corr_level(f1, f2, coords, radius, row_chunk=8),
+        fmap1, fmap2)
+    g1, g2 = vjp(g)
+    return g1, g2, jnp.zeros_like(coords)
+
+
+pallas_local_corr_level.defvjp(_fwd, _bwd)
