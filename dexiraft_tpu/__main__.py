@@ -1,0 +1,22 @@
+"""Package dispatcher: python -m dexiraft_tpu {train,eval,dexined} ..."""
+
+import sys
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "eval", "dexined"):
+        print("usage: python -m dexiraft_tpu {train,eval,dexined} [args...]",
+              file=sys.stderr)
+        raise SystemExit(2)
+    cmd, argv = sys.argv[1], sys.argv[2:]
+    if cmd == "train":
+        from dexiraft_tpu.train_cli import main as run
+    elif cmd == "eval":
+        from dexiraft_tpu.eval_cli import main as run
+    else:
+        from dexiraft_tpu.dexined_cli import main as run
+    run(argv)
+
+
+if __name__ == "__main__":
+    main()

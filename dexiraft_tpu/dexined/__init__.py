@@ -1,0 +1,18 @@
+"""DexiNed standalone edge-detection workload (reference core/DexiNed/):
+losses, BIPED-family datasets, and the train/test CLI driver."""
+
+from dexiraft_tpu.dexined.losses import (
+    bdcn_loss2,
+    cats_loss,
+    hed_loss2,
+    rcf_loss,
+    weighted_multiscale_loss,
+)
+
+__all__ = [
+    "bdcn_loss2",
+    "hed_loss2",
+    "rcf_loss",
+    "cats_loss",
+    "weighted_multiscale_loss",
+]

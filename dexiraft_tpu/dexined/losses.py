@@ -1,0 +1,130 @@
+"""Edge-detection losses (reference core/DexiNed/losses.py), in JAX.
+
+All take NHWC logits (B, H, W, 1) and targets in [0, 1] (same layout) and
+return a scalar; class balancing statistics are computed over the whole
+batch tensor, matching the torch versions. The RCF convention reserves
+target==2 for don't-care pixels.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-10
+
+
+def _bce_sum(probs: jax.Array, targets: jax.Array, weights: jax.Array) -> jax.Array:
+    p = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    ce = -(targets * jnp.log(p) + (1.0 - targets) * jnp.log(1.0 - p))
+    return jnp.sum(weights * ce)
+
+
+def bdcn_loss2(logits: jax.Array, targets: jax.Array,
+               l_weight: float = 1.1) -> jax.Array:
+    """Class-balanced BCE, BDCN/RCF weighting (losses.py:22-35):
+    positives (t > 0) weighted num_neg/total, negatives 1.1*num_pos/total."""
+    t = targets.astype(jnp.float32)
+    pos = (t > 0.0).astype(jnp.float32)
+    num_pos = jnp.sum(pos)
+    num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
+    total = num_pos + num_neg
+    w = jnp.where(pos > 0, num_neg / total, 1.1 * num_pos / total)
+    return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
+
+
+def hed_loss2(logits: jax.Array, targets: jax.Array,
+              l_weight: float = 1.1) -> jax.Array:
+    """HED variant: positive threshold at 0.1 (losses.py:6-19)."""
+    t = targets.astype(jnp.float32)
+    pos = (t > 0.1).astype(jnp.float32)
+    num_pos = jnp.sum(pos)
+    num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
+    total = num_pos + num_neg
+    w = jnp.where(pos > 0, num_neg / total, 1.1 * num_pos / total)
+    return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
+
+
+def rcf_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """RCF: strict positives (t > 0.5), zeros negative, t == 2 ignored
+    (losses.py:60-74)."""
+    t = targets.astype(jnp.float32)
+    pos = (t > 0.5) & (t < 1.5)
+    neg = t == 0.0
+    num_pos = jnp.sum(pos.astype(jnp.float32))
+    num_neg = jnp.sum(neg.astype(jnp.float32))
+    total = num_pos + num_neg
+    w = jnp.where(pos, num_neg / total,
+                  jnp.where(neg, 1.1 * num_pos / total, 0.0))
+    return _bce_sum(jax.nn.sigmoid(logits), jnp.where(pos, 1.0, 0.0), w)
+
+
+def _box_sum(x: jax.Array, radius: int) -> jax.Array:
+    """Sum over a (2r+1)^2 window, SAME padding — NHWC ones-kernel conv
+    (replaces F.conv2d(filt=ones); conv, not reduce_window, for clean
+    reverse-mode on every backend)."""
+    k = 2 * radius + 1
+    kernel = jnp.ones((k, k, 1, 1), x.dtype)
+    return jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bdrloss(prediction: jax.Array, label: jax.Array, radius: int) -> jax.Array:
+    """Boundary tracing loss (losses.py:77-104). prediction: probabilities."""
+    bdr_pred = prediction * label
+    pred_bdr_sum = label * _box_sum(bdr_pred, radius)
+
+    texture_mask = _box_sum(label, radius)
+    mask = ((texture_mask != 0.0) & (label != 1.0)).astype(jnp.float32)
+    pred_texture_sum = _box_sum(prediction * (1.0 - label) * mask, radius)
+
+    softmax_map = jnp.clip(
+        pred_bdr_sum / (pred_texture_sum + pred_bdr_sum + _EPS),
+        _EPS, 1.0 - _EPS)
+    cost = jnp.where(label == 0.0, 0.0, -label * jnp.log(softmax_map))
+    return jnp.sum(cost)
+
+
+def textureloss(prediction: jax.Array, label: jax.Array,
+                mask_radius: int) -> jax.Array:
+    """Texture suppression loss (losses.py:107-127). prediction: probs."""
+    pred_sums = _box_sum(prediction, 1)
+    label_sums = _box_sum(label, mask_radius)
+    mask = (label_sums == 0.0).astype(jnp.float32)
+    loss = -jnp.log(jnp.clip(1.0 - pred_sums / 9.0, _EPS, 1.0 - _EPS))
+    return jnp.sum(loss * mask)
+
+
+def cats_loss(logits: jax.Array, targets: jax.Array,
+              l_weight: Tuple[float, float] = (0.0, 0.0)) -> jax.Array:
+    """CATS: balanced BCE + boundary tracing + texture suppression
+    (losses.py:130-150). l_weight = (texture_factor, boundary_factor)."""
+    tex_factor, bdr_factor = l_weight
+    balanced_w = 1.1
+    t = targets.astype(jnp.float32)
+    num_pos = jnp.sum((t == 1.0).astype(jnp.float32))
+    num_neg = jnp.sum((t == 0.0).astype(jnp.float32))
+    beta = num_neg / (num_pos + num_neg + _EPS)
+    mask = jnp.where(t == 1.0, beta,
+                     jnp.where(t == 0.0, balanced_w * (1.0 - beta), 0.0))
+    prediction = jax.nn.sigmoid(logits)
+    cost = _bce_sum(prediction, t, mask)
+
+    label_w = (t != 0.0).astype(jnp.float32)
+    return (cost
+            + bdr_factor * bdrloss(prediction, label_w, radius=4)
+            + tex_factor * textureloss(prediction, label_w, mask_radius=4))
+
+
+# per-scale weights for the 7 DexiNed outputs (main.py:29)
+BDCN_SCALE_WEIGHTS = (0.7, 0.7, 1.1, 1.1, 0.3, 0.3, 1.3)
+
+
+def weighted_multiscale_loss(preds: Sequence[jax.Array], targets: jax.Array,
+                             weights: Sequence[float] = BDCN_SCALE_WEIGHTS,
+                             loss_fn=bdcn_loss2) -> jax.Array:
+    """sum_i loss_fn(preds[i], targets, w_i) (main.py:39)."""
+    return sum(loss_fn(p, targets, w) for p, w in zip(preds, weights))

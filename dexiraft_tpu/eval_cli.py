@@ -1,0 +1,86 @@
+"""Evaluation / submission CLI (reference: evaluate.py:212-243).
+
+  python -m dexiraft_tpu eval --model checkpoints/raft-things \
+      --dataset sintel --variant v5
+  python -m dexiraft_tpu eval --model ... --submission sintel --warm_start
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+
+from dexiraft_tpu.train_cli import VARIANTS, _VAL_ITERS
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dexiraft-eval")
+    p.add_argument("--model", required=True, help="orbax checkpoint dir")
+    p.add_argument("--dataset", choices=["chairs", "sintel", "kitti", "hd1k"])
+    p.add_argument("--submission", choices=["sintel", "kitti"])
+    p.add_argument("--warm_start", action="store_true")
+    p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--corr_impl", default="allpairs",
+                   choices=["allpairs", "local", "pallas"],
+                   help="'local'/'pallas' = the memory-efficient on-demand "
+                        "path (the reference's --alternate_corr)")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--output", default=None, help="submission output dir")
+    return p
+
+
+def load_variables(args):
+    from dexiraft_tpu.config import TrainConfig
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train.state import create_state
+
+    cfg = VARIANTS[args.variant](small=args.small,
+                                 mixed_precision=args.mixed_precision,
+                                 corr_impl=args.corr_impl)
+    template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
+    state = ckpt.restore_checkpoint(args.model, template)
+    return cfg, state.variables
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    if not args.dataset and not args.submission:
+        raise SystemExit("need --dataset or --submission")
+
+    from dexiraft_tpu.train.step import make_eval_step
+
+    cfg, variables = load_variables(args)
+
+    if args.dataset:
+        from dexiraft_tpu.eval.validate import VALIDATORS
+
+        iters = args.iters or _VAL_ITERS[args.dataset]
+        step = make_eval_step(cfg, iters=iters)
+        VALIDATORS[args.dataset](
+            lambda im1, im2, flow_init=None: step(variables, im1, im2,
+                                                  flow_init=flow_init))
+
+    if args.submission == "sintel":
+        from dexiraft_tpu.eval.submission import create_sintel_submission
+
+        step = make_eval_step(cfg, iters=args.iters or 32)
+        create_sintel_submission(
+            lambda im1, im2, flow_init=None: step(variables, im1, im2,
+                                                  flow_init=flow_init),
+            output_path=args.output or "sintel_submission",
+            warm_start=args.warm_start)
+    elif args.submission == "kitti":
+        from dexiraft_tpu.eval.submission import create_kitti_submission
+
+        step = make_eval_step(cfg, iters=args.iters or 24)
+        create_kitti_submission(
+            lambda im1, im2, flow_init=None: step(variables, im1, im2),
+            output_path=args.output or "kitti_submission")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -154,7 +154,8 @@ class LocalCorr:
             if self.use_pallas:
                 from dexiraft_tpu.ops.pallas_corr import pallas_local_corr_level
                 corr = pallas_local_corr_level(
-                    self.fmap1, f2, coords_i, self.radius)
+                    self.fmap1, f2, coords_i, self.radius,
+                    False, self.row_chunk)
             else:
                 corr = local_corr_level(
                     self.fmap1, f2, coords_i, self.radius, self.row_chunk)

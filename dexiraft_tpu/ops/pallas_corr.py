@@ -145,24 +145,30 @@ def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
     return out[:, :n].reshape(b, h, w, win * win)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def pallas_local_corr_level(fmap1, fmap2, coords, radius: int,
-                            interpret: bool = False):
-    """(B,H,W,C) x (B,H2,W2,C) x (B,H,W,2 level coords) -> (B,H,W,(2r+1)^2)."""
+                            interpret: bool = False, row_chunk=8):
+    """(B,H,W,C) x (B,H2,W2,C) x (B,H,W,2 level coords) -> (B,H,W,(2r+1)^2).
+
+    row_chunk only affects the backward recompute (the forward kernel is
+    already pixel-blocked); pass the model's corr_row_chunk so the VJP's
+    transient patch buffer honors the same bound.
+    """
     return _pallas_forward(fmap1, fmap2, coords, radius, interpret)
 
 
-def _fwd(fmap1, fmap2, coords, radius, interpret):
+def _fwd(fmap1, fmap2, coords, radius, interpret, row_chunk):
     return (_pallas_forward(fmap1, fmap2, coords, radius, interpret),
             (fmap1, fmap2, coords))
 
 
-def _bwd(radius, interpret, res, g):
+def _bwd(radius, interpret, row_chunk, res, g):
     fmap1, fmap2, coords = res
     # row-chunked recompute: bounds the backward's transient patch buffer
     # the same way the forward XLA path does
     _, vjp = jax.vjp(
-        lambda f1, f2: local_corr_level(f1, f2, coords, radius, row_chunk=8),
+        lambda f1, f2: local_corr_level(f1, f2, coords, radius,
+                                        row_chunk=row_chunk),
         fmap1, fmap2)
     g1, g2 = vjp(g)
     return g1, g2, jnp.zeros_like(coords)

@@ -1,0 +1,212 @@
+"""Training CLI — one resolved config tree instead of argparse x3 + .sh files.
+
+Reference surface: train.py:220-250 (flags, seeds, checkpoint dir) and the
+curriculum scripts train_standard.sh / train_mixed.sh. One invocation runs
+one stage; presets supply the per-stage hyperparameters:
+
+  python -m dexiraft_tpu train --stage chairs --name raft-chairs \
+      --variant v1 --validation chairs
+  python -m dexiraft_tpu train --preset standard --stage things \
+      --restore_ckpt checkpoints/raft-chairs
+
+The loop is the reference's (train.py:163-215) re-shaped for TPU: one
+jitted sharded step (forward + loss + backward + optimizer), batches
+sharded over the data mesh axis, VAL_FREQ checkpoint+validate, final save.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from dexiraft_tpu import config as cfglib
+from dexiraft_tpu.config import RAFTConfig, TrainConfig
+
+VARIANTS = {
+    "v1": cfglib.raft_v1, "raft": cfglib.raft_v1,
+    "v2": cfglib.raft_v2, "early": cfglib.raft_v2,
+    "v3": cfglib.raft_v3, "separate": cfglib.raft_v3,
+    "v4": cfglib.raft_v4,
+    "v5": cfglib.raft_v5, "dual": cfglib.raft_v5,
+}
+
+# reference in-training validation iteration counts (evaluate.py:81-210)
+_VAL_ITERS = {"chairs": 24, "sintel": 32, "kitti": 24, "hd1k": 24}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dexiraft-train")
+    p.add_argument("--name", default="raft", help="experiment name")
+    p.add_argument("--stage", required=True,
+                   choices=["chairs", "things", "sintel", "kitti"])
+    p.add_argument("--preset", choices=["standard", "mixed", "none"],
+                   default="none", help="stage hyperparameter preset")
+    p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--corr_impl", default="allpairs",
+                   choices=["allpairs", "local", "pallas"])
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--num_steps", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--image_size", type=int, nargs=2, default=None)
+    p.add_argument("--wdecay", type=float, default=None)
+    p.add_argument("--gamma", type=float, default=None)
+    p.add_argument("--clip", type=float, default=1.0)
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--add_noise", action="store_true")
+    p.add_argument("--validation", nargs="*", default=[],
+                   choices=sorted(_VAL_ITERS))
+    p.add_argument("--restore_ckpt", default=None,
+                   help="orbax dir for partial (strict=False-style) restore")
+    p.add_argument("--resume", action="store_true",
+                   help="restore FULL state (incl. optimizer/schedule) from "
+                        "--output/<name> and continue")
+    p.add_argument("--output", default="checkpoints")
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--val_freq", type=int, default=5000)
+    p.add_argument("--sum_freq", type=int, default=100)
+    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--log_dir", default="runs")
+    return p
+
+
+def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
+    cfg = VARIANTS[args.variant](
+        small=args.small,
+        mixed_precision=args.mixed_precision,
+        dropout=args.dropout,
+        corr_impl=args.corr_impl,
+    )
+
+    if args.preset != "none":
+        stages = (cfglib.STANDARD_STAGES if args.preset == "standard"
+                  else cfglib.MIXED_STAGES)
+        base = next(tc for tc in stages if tc.stage == args.stage)
+    else:
+        base = TrainConfig(stage=args.stage)
+
+    import dataclasses
+    overrides: Dict = dict(
+        name=args.name,
+        stage=args.stage,
+        clip=args.clip,
+        iters=args.iters,
+        add_noise=args.add_noise,
+        # freeze BN for every post-chairs stage (train.py:149-150)
+        freeze_bn=args.stage != "chairs",
+        val_freq=args.val_freq,
+        sum_freq=args.sum_freq,
+        seed=args.seed,
+        validation=tuple(args.validation),
+    )
+    for field, value in [("lr", args.lr), ("num_steps", args.num_steps),
+                         ("batch_size", args.batch_size),
+                         ("wdecay", args.wdecay), ("gamma", args.gamma)]:
+        if value is not None:
+            overrides[field] = value
+    if args.image_size is not None:
+        overrides["image_size"] = tuple(args.image_size)
+    return cfg, dataclasses.replace(base, **overrides)
+
+
+def _make_validators(cfg: RAFTConfig, names, variables_fn):
+    """Jitted eval fns per validation set, built once, reading the CURRENT
+    variables through variables_fn at call time."""
+    from dexiraft_tpu.eval.validate import VALIDATORS
+    from dexiraft_tpu.train.step import make_eval_step
+
+    steps = {n: make_eval_step(cfg, iters=_VAL_ITERS[n]) for n in names}
+
+    def run(name: str) -> Dict[str, float]:
+        fn = steps[name]
+        variables = variables_fn()
+        return VALIDATORS[name](
+            lambda im1, im2, flow_init=None: fn(variables, im1, im2,
+                                                flow_init=flow_init))
+
+    return run
+
+
+def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
+    import os.path as osp
+
+    from dexiraft_tpu.data.datasets import fetch_dataset
+    from dexiraft_tpu.data.loader import Loader
+    from dexiraft_tpu.parallel.mesh import make_mesh, shard_batch
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train.logger import Logger
+    from dexiraft_tpu.train.state import create_state, param_count
+    from dexiraft_tpu.train.step import make_train_step
+
+    np.random.seed(tc.seed)
+    ckpt_dir = osp.join(args.output, tc.name)
+
+    # the batch shards over the data axis, so the mesh takes the largest
+    # device count that divides it (a 10-batch on 8 chips uses 2 — pick
+    # batch sizes that are multiples of the slice size to use every chip)
+    devices = jax.devices()
+    n_use = max(n for n in range(1, len(devices) + 1)
+                if tc.batch_size % n == 0)
+    if n_use < len(devices):
+        print(f"[mesh] batch {tc.batch_size} not divisible by "
+              f"{len(devices)} devices; using {n_use}")
+    mesh = make_mesh(devices[:n_use])
+    state = create_state(jax.random.PRNGKey(tc.seed), cfg, tc)
+    print(f"Parameter Count: {param_count(state.params)}")
+
+    if args.resume and ckpt.latest_step(ckpt_dir) is not None:
+        state = ckpt.restore_checkpoint(ckpt_dir, state)
+        print(f"Resumed full state at step {int(state.step)}")
+    elif args.restore_ckpt:
+        prev = ckpt.restore_checkpoint(args.restore_ckpt, state)
+        merged, skipped = ckpt.restore_params_into(state.params, prev.params,
+                                                   verbose=True)
+        state = state.replace(params=merged, batch_stats=prev.batch_stats)
+        print(f"Partial restore from {args.restore_ckpt} "
+              f"({len(skipped)} leaves fresh)")
+
+    dataset = fetch_dataset(tc.stage, tc.image_size)
+    print(f"Training with {len(dataset)} image pairs")
+    loader = Loader(
+        dataset, tc.batch_size, seed=tc.seed, num_workers=args.num_workers,
+        process_index=jax.process_index(), process_count=jax.process_count())
+
+    step_fn = make_train_step(cfg, tc, mesh=mesh)
+    logger = Logger(tc.sum_freq, log_dir=osp.join(args.log_dir, tc.name),
+                    model_iters=tc.iters)
+    validate = _make_validators(cfg, tc.validation,
+                                lambda: state.variables)
+
+    total_steps = int(state.step)
+    with mesh:
+        for batch in loader:
+            state, metrics = step_fn(state, shard_batch(batch, mesh))
+            total_steps += 1
+            logger.push(metrics)
+
+            if total_steps % tc.val_freq == 0:
+                ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
+                for vname in tc.validation:
+                    logger.write_dict(validate(vname), step=total_steps)
+            if total_steps >= tc.num_steps:
+                break
+
+    ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
+    logger.close()
+    print(f"Done: {total_steps} steps -> {ckpt_dir}")
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    cfg, tc = resolve_configs(args)
+    train(cfg, tc, args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

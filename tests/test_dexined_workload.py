@@ -1,0 +1,137 @@
+"""DexiNed standalone workload: losses, datasets, train/test CLI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dexiraft_tpu.dexined.losses import (
+    bdcn_loss2,
+    cats_loss,
+    hed_loss2,
+    rcf_loss,
+    weighted_multiscale_loss,
+)
+
+
+def _logits_targets(key, shape=(2, 16, 16, 1), p_edge=0.1):
+    k1, k2 = jax.random.split(key)
+    logits = jax.random.normal(k1, shape)
+    targets = (jax.random.uniform(k2, shape) < p_edge).astype(jnp.float32)
+    return logits, targets
+
+
+class TestLosses:
+    def test_bdcn_positive_scalar_and_grad(self):
+        logits, targets = _logits_targets(jax.random.PRNGKey(0))
+        loss = bdcn_loss2(logits, targets)
+        assert loss.shape == () and float(loss) > 0
+        g = jax.grad(lambda l: bdcn_loss2(l, targets))(logits)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_bdcn_class_balance(self):
+        """With rare positives, a missed positive must cost more than an
+        equally-confident false positive (num_neg >> num_pos weighting)."""
+        targets = jnp.zeros((1, 8, 8, 1)).at[0, 4, 4, 0].set(1.0)
+        base = jnp.zeros((1, 8, 8, 1))
+        miss = base.at[0, 4, 4, 0].set(-4.0)  # confident wrong on the edge
+        fp = base.at[0, 2, 2, 0].set(4.0)     # confident wrong on background
+        assert float(bdcn_loss2(miss, targets)) > float(bdcn_loss2(fp, targets))
+
+    def test_hed_and_rcf_finite(self):
+        logits, targets = _logits_targets(jax.random.PRNGKey(1))
+        assert np.isfinite(float(hed_loss2(logits, targets)))
+        assert np.isfinite(float(rcf_loss(logits, targets)))
+
+    def test_rcf_ignores_dontcare(self):
+        logits = jnp.zeros((1, 4, 4, 1))
+        t_all2 = jnp.full((1, 4, 4, 1), 2.0)  # all don't-care
+        assert float(rcf_loss(logits, t_all2)) == 0.0
+
+    def test_cats_loss_components(self):
+        logits, targets = _logits_targets(jax.random.PRNGKey(2))
+        plain = cats_loss(logits, targets, (0.0, 0.0))
+        full = cats_loss(logits, targets, (0.01, 4.0))
+        assert np.isfinite(float(plain)) and np.isfinite(float(full))
+        g = jax.grad(lambda l: cats_loss(l, targets, (0.01, 4.0)))(logits)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_weighted_multiscale(self):
+        logits, targets = _logits_targets(jax.random.PRNGKey(3))
+        preds = [logits] * 7
+        loss = weighted_multiscale_loss(preds, targets)
+        single = bdcn_loss2(logits, targets, 1.0)
+        np.testing.assert_allclose(float(loss),
+                                   float(single) * (0.7 + 0.7 + 1.1 + 1.1
+                                                    + 0.3 + 0.3 + 1.3),
+                                   rtol=1e-5)
+
+
+@pytest.fixture()
+def biped_tree(tmp_path):
+    import cv2
+
+    rng = np.random.default_rng(0)
+    img_dir = tmp_path / "imgs" / "train" / "rgbr" / "aug" / "seq0"
+    gt_dir = tmp_path / "edge_maps" / "train" / "rgbr" / "aug" / "seq0"
+    img_dir.mkdir(parents=True)
+    gt_dir.mkdir(parents=True)
+    for i in range(3):
+        cv2.imwrite(str(img_dir / f"{i}.jpg"),
+                    rng.integers(0, 256, (300, 300, 3), dtype=np.uint8))
+        cv2.imwrite(str(gt_dir / f"{i}.png"),
+                    rng.integers(0, 256, (300, 300), dtype=np.uint8))
+    return tmp_path
+
+
+class TestEdgeDatasets:
+    def test_biped_sample(self, biped_tree):
+        from dexiraft_tpu.dexined.data import BipedDataset
+
+        ds = BipedDataset(str(biped_tree), img_size=64)
+        assert len(ds) == 3
+        s = ds.sample(0, np.random.default_rng(0))
+        assert s["images"].shape == (64, 64, 3)
+        assert s["labels"].shape == (64, 64, 1)
+        assert 0.0 <= s["labels"].min() and s["labels"].max() <= 1.0
+        # mean-subtracted: must have negative values
+        assert s["images"].min() < 0
+
+    def test_test_dataset_div16(self, biped_tree):
+        import cv2
+
+        from dexiraft_tpu.dexined.data import TestDataset
+
+        d = biped_tree / "classic"
+        d.mkdir()
+        cv2.imwrite(str(d / "a.jpg"),
+                    np.random.default_rng(1).integers(
+                        0, 256, (100, 210, 3), dtype=np.uint8))
+        ds = TestDataset(str(d))
+        s = ds.sample(0)
+        h, w = s["images"].shape[:2]
+        assert h % 16 == 0 and w % 16 == 0
+        assert s["image_shape"] == (100, 210)
+
+
+def test_cli_train_then_test(biped_tree, tmp_path, monkeypatch):
+    import cv2
+
+    from dexiraft_tpu.dexined_cli import main
+
+    monkeypatch.chdir(tmp_path)
+    ckpt = str(tmp_path / "ck")
+    main(["--train", "--data_root", str(biped_tree), "--epochs", "1",
+          "--batch_size", "2", "--img_size", "64", "--lr", "1e-4",
+          "--steps_per_epoch", "2", "--checkpoint", ckpt])
+
+    classic = biped_tree / "classic"
+    classic.mkdir(exist_ok=True)
+    cv2.imwrite(str(classic / "t.jpg"),
+                np.random.default_rng(2).integers(
+                    0, 256, (64, 64, 3), dtype=np.uint8))
+    out = str(tmp_path / "res")
+    main(["--test", "--data_root", str(classic), "--dataset", "CLASSIC",
+          "--checkpoint", ckpt, "--output_dir", out])
+    import os
+    assert os.path.exists(os.path.join(out, "CLASSIC", "t.png"))
