@@ -20,7 +20,12 @@ FLO_MAGIC = 202021.25  # 'PIEH' interpreted as float32
 
 
 def read_flo(path: Union[str, os.PathLike]) -> np.ndarray:
-    """Middlebury .flo -> (H, W, 2) float32."""
+    """Middlebury .flo -> (H, W, 2) float32 (native decoder when built)."""
+    from dexiraft_tpu.data import native
+
+    out = native.read_flo_native(path)
+    if out is not None:
+        return out
     with open(path, "rb") as f:
         magic = np.frombuffer(f.read(4), np.float32)[0]
         if magic != np.float32(FLO_MAGIC):
@@ -124,7 +129,16 @@ def read_disp_kitti(path: Union[str, os.PathLike]) -> Tuple[np.ndarray, np.ndarr
 
 
 def read_image(path: Union[str, os.PathLike]) -> np.ndarray:
-    """8-bit image -> (H, W, 3) uint8 (grayscale promoted, alpha dropped)."""
+    """8-bit image -> (H, W, 3) uint8 (grayscale promoted, alpha dropped).
+
+    Binary PPMs (the FlyingChairs format) take the native decoder when
+    available; everything else goes through imageio."""
+    if os.fspath(path).lower().endswith(".ppm"):
+        from dexiraft_tpu.data import native
+
+        out = native.read_ppm_native(path)
+        if out is not None:
+            return out
     import imageio.v2 as imageio
 
     img = np.asarray(imageio.imread(os.fspath(path)))

@@ -25,8 +25,13 @@ def _bce_sum(probs: jax.Array, targets: jax.Array, weights: jax.Array) -> jax.Ar
 def bdcn_loss2(logits: jax.Array, targets: jax.Array,
                l_weight: float = 1.1) -> jax.Array:
     """Class-balanced BCE, BDCN/RCF weighting (losses.py:22-35):
-    positives (t > 0) weighted num_neg/total, negatives 1.1*num_pos/total."""
-    t = targets.astype(jnp.float32)
+    positives (t > 0) weighted num_neg/total, negatives 1.1*num_pos/total.
+
+    The torch version first casts targets.long(), truncating every
+    sub-1.0 annotation to 0 — only exactly-1.0 pixels are positives and
+    the BCE target itself is the binarized map; floor() reproduces that.
+    """
+    t = jnp.floor(targets.astype(jnp.float32))
     pos = (t > 0.0).astype(jnp.float32)
     num_pos = jnp.sum(pos)
     num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
@@ -37,8 +42,9 @@ def bdcn_loss2(logits: jax.Array, targets: jax.Array,
 
 def hed_loss2(logits: jax.Array, targets: jax.Array,
               l_weight: float = 1.1) -> jax.Array:
-    """HED variant: positive threshold at 0.1 (losses.py:6-19)."""
-    t = targets.astype(jnp.float32)
+    """HED variant: positive threshold at 0.1 (losses.py:6-19); same
+    targets.long() binarization as bdcn_loss2."""
+    t = jnp.floor(targets.astype(jnp.float32))
     pos = (t > 0.1).astype(jnp.float32)
     num_pos = jnp.sum(pos)
     num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
@@ -49,8 +55,8 @@ def hed_loss2(logits: jax.Array, targets: jax.Array,
 
 def rcf_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """RCF: strict positives (t > 0.5), zeros negative, t == 2 ignored
-    (losses.py:60-74)."""
-    t = targets.astype(jnp.float32)
+    (losses.py:60-74); targets.long()-binarized like the torch version."""
+    t = jnp.floor(targets.astype(jnp.float32))
     pos = (t > 0.5) & (t < 1.5)
     neg = t == 0.0
     num_pos = jnp.sum(pos.astype(jnp.float32))

@@ -128,7 +128,10 @@ def test(args) -> None:
     from dexiraft_tpu.train import checkpoint as ckpt_io
 
     info = DATASET_INFO[args.dataset]
-    dataset = TestDataset(args.data_root, mean_bgr=info.mean_bgr,
+    # registry eval resolutions: one static shape -> one jit compile, and
+    # the reference's per-dataset test protocol (datasets.py:9-149)
+    dataset = TestDataset(args.data_root, img_height=info.img_height,
+                          img_width=info.img_width, mean_bgr=info.mean_bgr,
                           test_list=info.test_list)
 
     model = DexiNed()

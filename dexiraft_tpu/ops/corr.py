@@ -55,11 +55,13 @@ def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
     core/raft.py:139-142.
     """
     b, h, w, d = fmap1.shape
+    h2, w2 = fmap2.shape[1:3]  # may differ from (h, w) when the query
+    # axis is sharded (context parallelism, parallel/context.py)
     f1 = fmap1.reshape(b, h * w, d).astype(jnp.float32)
-    f2 = fmap2.reshape(b, h * w, d).astype(jnp.float32)
+    f2 = fmap2.reshape(b, h2 * w2, d).astype(jnp.float32)
     corr = jnp.einsum("bnd,bmd->bnm", f1, f2, preferred_element_type=jnp.float32)
     corr = corr / jnp.sqrt(jnp.float32(d))
-    return corr.reshape(b * h * w, h, w, 1)
+    return corr.reshape(b * h * w, h2, w2, 1)
 
 
 def avg_pool_2x2(x: jax.Array) -> jax.Array:

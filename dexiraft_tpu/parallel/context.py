@@ -1,0 +1,62 @@
+"""Sequence/context parallelism for the correlation volume.
+
+The all-pairs volume is quadratic in pixels exactly like attention in
+tokens — (H/8*W/8)^2 entries (SURVEY.md §5). For frames too large for one
+chip's HBM, shard the QUERY axis (the volume's first HW dimension) across
+a 'seq' mesh axis: each chip builds and looks up only its row-block of
+the volume against the replicated target features — flash-attention-style
+row parallelism with zero per-iteration communication (the only
+collective is the all-gather of fmap2, inserted once by the partitioner).
+
+Two complementary mechanisms:
+  * context_parallel_corr — explicit shard_map over a (data, seq) mesh;
+    used when you want manual control (and it documents the math).
+  * spatial input shardings (parallel.mesh.spatial_sharding) — GSPMD
+    auto-partitioning of the full train step: annotate batch images with
+    P('data', 'seq') over H and XLA partitions the encoders (halo
+    exchanges), the volume matmul, and the lookup automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from dexiraft_tpu.ops.corr import build_corr_pyramid, corr_lookup
+from dexiraft_tpu.parallel.mesh import SEQ_AXIS
+
+
+def context_parallel_corr(
+    fmap1: jax.Array,
+    fmap2: jax.Array,
+    coords: jax.Array,
+    mesh: Mesh,
+    num_levels: int = 4,
+    radius: int = 4,
+) -> jax.Array:
+    """Row-sharded all-pairs correlation lookup.
+
+    fmap1, fmap2: (B, H, W, D); coords: (B, H, W, 2) in level-0 pixels.
+    fmap1/coords shard over H on the 'seq' axis; fmap2 replicates (it is
+    the target space every query row needs). Each shard materializes its
+    (B * H_loc * W, H, W) volume slice and samples it — the full volume
+    never exists on any single chip.
+
+    Returns (B, H, W, num_levels * (2r+1)^2), sharded like the inputs.
+    """
+    if SEQ_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{SEQ_AXIS}' axis: {mesh.axis_names}")
+    q_spec = P(None, SEQ_AXIS, None, None)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(q_spec, P(), q_spec), out_specs=q_spec)
+    def _lookup(f1_loc, f2_full, coords_loc):
+        pyr = build_corr_pyramid(f1_loc, f2_full, num_levels, radius)
+        return corr_lookup(pyr, coords_loc)
+
+    return _lookup(fmap1, fmap2, coords)

@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = DATA_AXIS) -> Mesh:
@@ -29,9 +30,50 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = DATA_A
     return Mesh(np.asarray(devices), (axis,))
 
 
+def make_mesh_2d(
+    n_data: int,
+    n_seq: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(data, seq) mesh: batch DP x spatial/sequence CP.
+
+    The seq axis shards image rows (and with them the quadratic
+    correlation volume's query axis — see parallel.context). Keep seq
+    groups on adjacent devices so the fmap2 all-gather rides ICI
+    neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_data * n_seq > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_seq} needs {n_data * n_seq} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[: n_data * n_seq]).reshape(n_data, n_seq)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+
+
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     """Shard the leading (batch) dim over the data axis."""
     return NamedSharding(mesh, P(axis))
+
+
+def spatial_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch over 'data' AND image rows over 'seq' (context parallelism):
+    GSPMD partitions convolutions with halo exchange and the correlation
+    volume by query rows under this annotation."""
+    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+
+
+def shard_batch_spatial(batch: Any, mesh: Mesh) -> Any:
+    """device_put a host batch with (data, seq) sharding: 3D/4D image-like
+    leaves shard over (batch, rows); everything else batch-only."""
+    sp = spatial_sharding(mesh)
+    bo = batch_sharding(mesh)
+
+    def put(x):
+        return jax.device_put(x, sp if np.ndim(x) >= 3 else bo)
+
+    return jax.tree.map(put, batch)
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

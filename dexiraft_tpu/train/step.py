@@ -25,7 +25,12 @@ from jax.sharding import Mesh
 from dexiraft_tpu.config import RAFTConfig, TrainConfig
 from dexiraft_tpu.models.raft import RAFT
 from dexiraft_tpu.ops.losses import sequence_loss
-from dexiraft_tpu.parallel.mesh import batch_sharding, replicated_sharding
+from dexiraft_tpu.parallel.mesh import (
+    SEQ_AXIS,
+    batch_sharding,
+    replicated_sharding,
+    spatial_sharding,
+)
 from dexiraft_tpu.train.optimizer import training_schedule
 from dexiraft_tpu.train.state import TrainState, make_optimizer_from
 
@@ -98,7 +103,12 @@ def make_train_step(
         return jax.jit(step, donate_argnums=0)
 
     repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
+    # 2-D (data, seq) mesh: image rows additionally shard over 'seq' —
+    # GSPMD partitions the convs (halo exchange) and the correlation
+    # volume's query axis (context parallelism); every batch leaf is >=3D
+    # (B, H, ...), so one spec covers the dict
+    data = (spatial_sharding(mesh) if SEQ_AXIS in mesh.axis_names
+            else batch_sharding(mesh))
     return jax.jit(
         step,
         in_shardings=(repl, data),

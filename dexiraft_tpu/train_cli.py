@@ -40,7 +40,9 @@ _VAL_ITERS = {"chairs": 24, "sintel": 32, "kitti": 24, "hd1k": 24}
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dexiraft-train")
-    p.add_argument("--name", default="raft", help="experiment name")
+    p.add_argument("--name", default=None,
+                   help="experiment name (default: preset's per-stage name, "
+                        "else 'raft')")
     p.add_argument("--stage", required=True,
                    choices=["chairs", "things", "sintel", "kitti"])
     p.add_argument("--preset", choices=["standard", "mixed", "none"],
@@ -60,8 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip", type=float, default=1.0)
     p.add_argument("--iters", type=int, default=12)
     p.add_argument("--add_noise", action="store_true")
-    p.add_argument("--validation", nargs="*", default=[],
-                   choices=sorted(_VAL_ITERS))
+    p.add_argument("--validation", nargs="*", default=None,
+                   choices=sorted(_VAL_ITERS),
+                   help="default: the preset's per-stage validation sets")
     p.add_argument("--restore_ckpt", default=None,
                    help="orbax dir for partial (strict=False-style) restore")
     p.add_argument("--resume", action="store_true",
@@ -93,7 +96,6 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
 
     import dataclasses
     overrides: Dict = dict(
-        name=args.name,
         stage=args.stage,
         clip=args.clip,
         iters=args.iters,
@@ -103,8 +105,14 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         val_freq=args.val_freq,
         sum_freq=args.sum_freq,
         seed=args.seed,
-        validation=tuple(args.validation),
     )
+    # None = "not given": keep the preset's per-stage name/validation
+    if args.name is not None:
+        overrides["name"] = args.name
+    elif args.preset == "none":
+        overrides["name"] = "raft"
+    if args.validation is not None:
+        overrides["validation"] = tuple(args.validation)
     for field, value in [("lr", args.lr), ("num_steps", args.num_steps),
                          ("batch_size", args.batch_size),
                          ("wdecay", args.wdecay), ("gamma", args.gamma)]:

@@ -1,0 +1,140 @@
+// Native data-path primitives for dexiraft_tpu.
+//
+// The reference keeps its data pipeline in Python workers
+// (core/datasets.py + torch DataLoader, 4 forked workers); its only native
+// code is the CUDA correlation kernel. Here the decode hot path is native
+// instead: C ABI decoders for the Middlebury .flo and binary PPM formats,
+// plus thread-pooled batch variants that decode a whole training batch in
+// one GIL-free call (Python threads serialize on the interpreter lock;
+// these do not).
+//
+// Build: g++ -O3 -shared -fPIC -pthread (driven by dexiraft_tpu/data/native.py).
+// Every function returns 0 on success, negative errno-style codes otherwise.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr float kFloMagic = 202021.25f;  // 'PIEH'
+
+struct File {
+  FILE* f;
+  explicit File(const char* path) : f(std::fopen(path, "rb")) {}
+  ~File() {
+    if (f) std::fclose(f);
+  }
+};
+
+int read_flo_into(const char* path, float* out, int64_t cap, int* w, int* h) {
+  File file(path);
+  if (!file.f) return -1;
+  float magic;
+  int32_t dims[2];
+  if (std::fread(&magic, 4, 1, file.f) != 1 || magic != kFloMagic) return -2;
+  if (std::fread(dims, 4, 2, file.f) != 2) return -2;
+  const int64_t n = int64_t(dims[0]) * dims[1] * 2;
+  if (n <= 0 || n > (int64_t(1) << 31)) return -2;
+  if (w) *w = dims[0];
+  if (h) *h = dims[1];
+  if (!out) return 0;  // dims-only query
+  if (n > cap) return -3;
+  if (std::fread(out, 4, size_t(n), file.f) != size_t(n)) return -2;
+  return 0;
+}
+
+// binary PPM (P6, maxval 255): the FlyingChairs image format
+int read_ppm_into(const char* path, uint8_t* out, int64_t cap, int* w, int* h) {
+  File file(path);
+  if (!file.f) return -1;
+  char tag[3] = {0};
+  if (std::fscanf(file.f, "%2s", tag) != 1 || std::strcmp(tag, "P6") != 0)
+    return -2;
+  // header fields with '#' comment lines allowed between tokens
+  int vals[3], got = 0;
+  while (got < 3) {
+    int c = std::fgetc(file.f);
+    if (c == EOF) return -2;
+    if (c == '#') {
+      while (c != '\n' && c != EOF) c = std::fgetc(file.f);
+    } else if (c >= '0' && c <= '9') {
+      std::ungetc(c, file.f);
+      if (std::fscanf(file.f, "%d", &vals[got++]) != 1) return -2;
+    }
+  }
+  if (vals[2] != 255) return -4;
+  std::fgetc(file.f);  // single whitespace after maxval
+  const int64_t n = int64_t(vals[0]) * vals[1] * 3;
+  if (w) *w = vals[0];
+  if (h) *h = vals[1];
+  if (!out) return 0;
+  if (n > cap) return -3;
+  if (std::fread(out, 1, size_t(n), file.f) != size_t(n)) return -2;
+  return 0;
+}
+
+template <typename Fn>
+void parallel_for(int n, int nthreads, Fn fn) {
+  if (nthreads <= 1 || n <= 1) {
+    for (int i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<int> next{0};
+  auto worker = [&] {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) fn(i);
+  };
+  std::vector<std::thread> pool;
+  const int k = std::min(nthreads, n);
+  pool.reserve(size_t(k));
+  for (int t = 0; t < k; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+int drn_read_flo(const char* path, float* out, int64_t cap, int* w, int* h) {
+  return read_flo_into(path, out, cap, w, h);
+}
+
+int drn_read_ppm(const char* path, uint8_t* out, int64_t cap, int* w, int* h) {
+  return read_ppm_into(path, out, cap, w, h);
+}
+
+// Batch decode into a contiguous (n, h, w, 2) float buffer; every file must
+// match the given dims (FlyingChairs is uniform 384x512). Returns 0 or the
+// first failing file's negative code.
+int drn_read_flo_batch(const char** paths, int n, float* out, int w, int h,
+                       int nthreads) {
+  std::atomic<int> status{0};
+  const int64_t per = int64_t(w) * h * 2;
+  parallel_for(n, nthreads, [&](int i) {
+    int fw = 0, fh = 0;
+    int rc = read_flo_into(paths[i], out + per * i, per, &fw, &fh);
+    if (rc == 0 && (fw != w || fh != h)) rc = -5;
+    int expected = 0;
+    if (rc != 0) status.compare_exchange_strong(expected, rc);
+  });
+  return status.load();
+}
+
+int drn_read_ppm_batch(const char** paths, int n, uint8_t* out, int w, int h,
+                       int nthreads) {
+  std::atomic<int> status{0};
+  const int64_t per = int64_t(w) * h * 3;
+  parallel_for(n, nthreads, [&](int i) {
+    int fw = 0, fh = 0;
+    int rc = read_ppm_into(paths[i], out + per * i, per, &fw, &fh);
+    if (rc == 0 && (fw != w || fh != h)) rc = -5;
+    int expected = 0;
+    if (rc != 0) status.compare_exchange_strong(expected, rc);
+  });
+  return status.load();
+}
+
+}  // extern "C"
