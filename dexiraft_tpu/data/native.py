@@ -13,7 +13,7 @@ import os
 import os.path as osp
 import subprocess
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -33,11 +33,19 @@ def _build() -> Optional[str]:
     if (osp.exists(_SO)
             and os.stat(_SO).st_mtime >= os.stat(_SRC).st_mtime):
         return _SO
+    # compile to a private temp path, then atomically publish: concurrent
+    # processes must never dlopen a half-written ELF
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (subprocess.SubprocessError, FileNotFoundError):
+        os.replace(tmp, _SO)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
     return _SO
 
@@ -76,49 +84,53 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-def _dims(fn, path: str) -> Optional[Tuple[int, int]]:
-    w = ctypes.c_int32()
-    h = ctypes.c_int32()
-    rc = fn(os.fspath(path).encode(), None, 0,
-            ctypes.byref(w), ctypes.byref(h))
-    if rc != 0:
-        return None
-    return int(w.value), int(h.value)
-
-
 def read_flo_native(path) -> Optional[np.ndarray]:
     """(H, W, 2) float32, or None when the native path is unavailable OR
     declines the file (caller falls through to the Python codec, which
-    owns the descriptive errors)."""
+    owns the descriptive errors). One open, one call: the buffer is sized
+    from the file length (payload = size - 12-byte header)."""
     lib = get_lib()
     if lib is None:
         return None
-    dims = _dims(lib.drn_read_flo, path)
-    if dims is None:
+    try:
+        n = (os.stat(path).st_size - 12) // 4
+    except OSError:
         return None
-    w, h = dims
-    out = np.empty((h, w, 2), np.float32)
+    if n <= 0:
+        return None
+    flat = np.empty(n, np.float32)
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
     rc = lib.drn_read_flo(os.fspath(path).encode(),
-                          out.ctypes.data_as(ctypes.c_void_p), out.size,
-                          None, None)
-    return out if rc == 0 else None
+                          flat.ctypes.data_as(ctypes.c_void_p), n,
+                          ctypes.byref(w), ctypes.byref(h))
+    if rc != 0 or int(h.value) * int(w.value) * 2 != n:
+        return None
+    return flat.reshape(int(h.value), int(w.value), 2)
 
 
 def read_ppm_native(path) -> Optional[np.ndarray]:
     """(H, W, 3) uint8, or None when unavailable or declined (e.g. ASCII
-    P3 or 16-bit PPMs go back to imageio)."""
+    P3 or 16-bit PPMs go back to imageio). Buffer bounded by file size."""
     lib = get_lib()
     if lib is None:
         return None
-    dims = _dims(lib.drn_read_ppm, path)
-    if dims is None:
+    try:
+        cap = os.stat(path).st_size  # >= payload (header is extra slack)
+    except OSError:
         return None
-    w, h = dims
-    out = np.empty((h, w, 3), np.uint8)
+    flat = np.empty(cap, np.uint8)
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
     rc = lib.drn_read_ppm(os.fspath(path).encode(),
-                          out.ctypes.data_as(ctypes.c_void_p), out.size,
-                          None, None)
-    return out if rc == 0 else None
+                          flat.ctypes.data_as(ctypes.c_void_p), cap,
+                          ctypes.byref(w), ctypes.byref(h))
+    if rc != 0:
+        return None
+    n = int(h.value) * int(w.value) * 3
+    if n > cap:
+        return None
+    return flat[:n].reshape(int(h.value), int(w.value), 3)
 
 
 def _paths_array(paths: Sequence[str]):

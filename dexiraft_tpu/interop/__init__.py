@@ -1,0 +1,8 @@
+"""Checkpoint interop with the reference's torch .pth state dicts."""
+
+from dexiraft_tpu.interop.torch_convert import (
+    convert_dexined_state_dict,
+    load_dexined_pth,
+)
+
+__all__ = ["convert_dexined_state_dict", "load_dexined_pth"]

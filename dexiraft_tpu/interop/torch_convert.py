@@ -1,0 +1,365 @@
+"""torch .pth -> flax variables converter (SURVEY.md §7 hard part 6).
+
+Lets reference checkpoints (e.g. the frozen DexiNed `14_model.pth` that
+core/raft.py:30-33 embeds) run in this framework without retraining, and
+provides the numerical parity bridge used by the interop tests.
+
+Layout rules:
+  conv weight           OIHW -> HWIO             transpose (2, 3, 1, 0)
+  conv-transpose weight (in, out, kH, kW) -> flax (kH, kW, out, in-group)
+                        with spatial flip (torch's ConvTranspose2d is the
+                        gradient of a strided conv; flax's ConvTranspose
+                        is a true fractionally-strided conv, so the kernel
+                        must be mirrored — validated by the parity test)
+  bn weight/bias        -> params scale/bias
+  bn running_mean/var   -> batch_stats mean/var
+  num_batches_tracked   dropped
+
+The name map is explicit (reference attribute names -> our flax
+auto-numbered module paths, derived from identical construction order in
+models/dexined.py) and every converted leaf is shape-checked, so a drift
+in either architecture fails loudly rather than silently misloading.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+# reference attribute -> our module path (models/dexined.py call order)
+_DEXINED_BLOCKS = {
+    "block_1": "DoubleConvBlock_0",
+    "block_2": "DoubleConvBlock_1",
+    "dblock_3": "DenseBlock_0",
+    "dblock_4": "DenseBlock_1",
+    "dblock_5": "DenseBlock_2",
+    "dblock_6": "DenseBlock_3",
+    "side_1": "SingleConvBlock_0",
+    "side_2": "SingleConvBlock_1",
+    "side_3": "SingleConvBlock_3",
+    "side_4": "SingleConvBlock_5",
+    "side_5": "side_5",
+    "pre_dense_3": "SingleConvBlock_2",
+    "pre_dense_4": "SingleConvBlock_4",
+    "pre_dense_5": "SingleConvBlock_6",
+    "pre_dense_6": "SingleConvBlock_7",
+    "block_cat": "SingleConvBlock_8",
+    "up_block_1": "UpConvBlock_0",
+    "up_block_2": "UpConvBlock_1",
+    "up_block_3": "UpConvBlock_2",
+    "up_block_4": "UpConvBlock_3",
+    "up_block_5": "UpConvBlock_4",
+    "up_block_6": "UpConvBlock_5",
+}
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _set(tree: Dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def _convert_leaf(torch_key: str, sub: str, leaf: str, value: np.ndarray):
+    """-> (collection, module, param_name, converted array) or None."""
+    if leaf == "num_batches_tracked":
+        return None
+    if sub.startswith("conv") or sub == "conv":
+        if leaf == "weight":
+            return "params", f"Conv_{_idx(sub, 'conv')}", "kernel", \
+                value.transpose(2, 3, 1, 0)
+        return "params", f"Conv_{_idx(sub, 'conv')}", "bias", value
+    if sub.startswith(("bn", "norm")) or sub == "bn":
+        base = "bn" if sub.startswith("bn") else "norm"
+        mod = f"BatchNorm_{_idx(sub, base)}"
+        if leaf == "weight":
+            return "params", mod, "scale", value
+        if leaf == "bias":
+            return "params", mod, "bias", value
+        if leaf == "running_mean":
+            return "batch_stats", mod, "mean", value
+        if leaf == "running_var":
+            return "batch_stats", mod, "var", value
+    raise KeyError(f"unhandled torch key {torch_key!r}")
+
+
+def _idx(name: str, base: str) -> int:
+    """conv -> 0, conv1 -> 0, conv2 -> 1, bn2 -> 1, norm1 -> 0 ..."""
+    suffix = name[len(base):]
+    return int(suffix) - 1 if suffix else 0
+
+
+def _convert_upblock_leaf(feat_idx: int, leaf: str, value: np.ndarray):
+    """UpConvBlock torch Sequential indices: 0,3,6,... are 1x1 convs;
+    2,5,8,... are ConvTranspose2d (model.py:81-109, conv/relu/deconv
+    triplets)."""
+    triplet, pos = divmod(feat_idx, 3)
+    if pos == 0:  # 1x1 conv
+        if leaf == "weight":
+            return f"Conv_{triplet}", "kernel", value.transpose(2, 3, 1, 0)
+        return f"Conv_{triplet}", "bias", value
+    if pos == 2:  # transposed conv: (in, out, kH, kW) -> (kH, kW, in, out),
+        # spatially flipped (gradient-of-conv vs fractionally-strided conv)
+        if leaf == "weight":
+            k = value.transpose(2, 3, 0, 1)[::-1, ::-1]
+            return f"ConvTranspose_{triplet}", "kernel", np.ascontiguousarray(k)
+        return f"ConvTranspose_{triplet}", "bias", value
+    raise KeyError(f"unexpected UpConvBlock feature index {feat_idx}")
+
+
+def convert_dexined_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Reference DexiNed state_dict -> {'params': ..., 'batch_stats': ...}."""
+    out: Dict[str, Any] = {"params": {}, "batch_stats": {}}
+    for key, raw in state_dict.items():
+        value = _to_numpy(raw).astype(np.float32)
+        parts = key.split(".")
+        block = parts[0]
+        if block not in _DEXINED_BLOCKS:
+            raise KeyError(f"unknown DexiNed block {block!r} in {key!r}")
+        ours = _DEXINED_BLOCKS[block]
+
+        if block.startswith("up_block"):
+            assert parts[1] == "features", key
+            mod, name, conv = _convert_upblock_leaf(
+                int(parts[2]), parts[3], value)
+            _set(out["params"], (ours, mod, name), conv)
+            continue
+
+        if block.startswith("dblock"):
+            # dblock_k.denselayer{j}.{conv|norm}{i}.{leaf}
+            layer = f"DenseLayer_{int(parts[1].removeprefix('denselayer')) - 1}"
+            res = _convert_leaf(key, parts[2], parts[3], value)
+            if res is None:
+                continue
+            coll, mod, name, conv = res
+            _set(out[coll], (ours, layer, mod, name), conv)
+            continue
+
+        res = _convert_leaf(key, parts[1], parts[2], value)
+        if res is None:
+            continue
+        coll, mod, name, conv = res
+        _set(out[coll], (ours, mod, name), conv)
+    return out
+
+
+def verify_against(template: Mapping[str, Any],
+                   converted: Mapping[str, Any]) -> None:
+    """Assert converted tree paths/shapes exactly match a model-init
+    template (strict load — unlike restore_params_into)."""
+    import jax
+
+    t_flat = {jax.tree_util.keystr(k): v.shape for k, v in
+              jax.tree_util.tree_flatten_with_path(template)[0]}
+    c_flat = {jax.tree_util.keystr(k): v.shape for k, v in
+              jax.tree_util.tree_flatten_with_path(dict(converted))[0]}
+    missing = sorted(set(t_flat) - set(c_flat))
+    extra = sorted(set(c_flat) - set(t_flat))
+    bad = [k for k in t_flat.keys() & c_flat.keys()
+           if tuple(t_flat[k]) != tuple(c_flat[k])]
+    if missing or extra or bad:
+        raise ValueError(
+            f"conversion mismatch: missing={missing[:5]} extra={extra[:5]} "
+            f"shape={[(k, t_flat[k], c_flat[k]) for k in bad[:5]]}")
+
+
+# ---------------------------------------------------------------------------
+# RAFT (core/raft.py family)
+# ---------------------------------------------------------------------------
+
+# update_block.* -> ScanRAFTStep_0.BasicUpdateBlock_0.* (full model)
+_UPDATE_BLOCK_FULL = {
+    "encoder.convc1": ("BasicMotionEncoder_0", "Conv_0"),
+    "encoder.convc2": ("BasicMotionEncoder_0", "Conv_1"),
+    "encoder.convf1": ("BasicMotionEncoder_0", "Conv_2"),
+    "encoder.convf2": ("BasicMotionEncoder_0", "Conv_3"),
+    "encoder.conv": ("BasicMotionEncoder_0", "Conv_4"),
+    "gru.convz1": ("SepConvGRU_0", "Conv_0"),
+    "gru.convr1": ("SepConvGRU_0", "Conv_1"),
+    "gru.convq1": ("SepConvGRU_0", "Conv_2"),
+    "gru.convz2": ("SepConvGRU_0", "Conv_3"),
+    "gru.convr2": ("SepConvGRU_0", "Conv_4"),
+    "gru.convq2": ("SepConvGRU_0", "Conv_5"),
+    "flow_head.conv1": ("FlowHead_0", "Conv_0"),
+    "flow_head.conv2": ("FlowHead_0", "Conv_1"),
+    "mask.0": ("Conv_0",),
+    "mask.2": ("Conv_1",),
+}
+
+# small model (SmallUpdateBlock: SmallMotionEncoder + ConvGRU, no mask)
+_UPDATE_BLOCK_SMALL = {
+    "encoder.convc1": ("SmallMotionEncoder_0", "Conv_0"),
+    "encoder.convf1": ("SmallMotionEncoder_0", "Conv_1"),
+    "encoder.convf2": ("SmallMotionEncoder_0", "Conv_2"),
+    "encoder.conv": ("SmallMotionEncoder_0", "Conv_3"),
+    "gru.convz": ("ConvGRU_0", "Conv_0"),
+    "gru.convr": ("ConvGRU_0", "Conv_1"),
+    "gru.convq": ("ConvGRU_0", "Conv_2"),
+    "flow_head.conv1": ("FlowHead_0", "Conv_0"),
+    "flow_head.conv2": ("FlowHead_0", "Conv_1"),
+}
+
+
+def _convert_encoder_key(parts, value):
+    """BasicEncoder/SmallEncoder names -> our extractor module paths.
+
+    Stem: conv1 -> Conv_0, norm1 -> BatchNorm_0 (batch-norm encoders only;
+    instance norm is parameter-free on both sides), conv2 -> Conv_1.
+    layer{L}.{j} -> ResidualBlock/BottleneckBlock_{2(L-1)+j}: convN ->
+    Conv_{N-1}, normN -> BatchNorm_{N-1}, downsample.0 -> shortcut conv,
+    downsample.1 -> shortcut BN. The bare normK that aliases downsample.1
+    (reference registers the same module twice, extractor.py) is skipped
+    by the caller when a downsample exists in the same block.
+    """
+    sub, leaf = parts[-2], parts[-1]
+    if parts[0] == "conv1":
+        mod = ("Conv_0",)
+    elif parts[0] == "conv2":
+        mod = ("Conv_1",)
+    elif parts[0] == "norm1":
+        mod = ("BatchNorm_0",)
+    elif parts[0].startswith("layer"):
+        layer = int(parts[0].removeprefix("layer"))
+        block = f"ResidualBlock_{2 * (layer - 1) + int(parts[1])}"
+        if sub == "downsample" or parts[2] == "downsample":
+            # conv-only blocks use Conv_2 for the shortcut; normed blocks
+            # Conv_2 + BatchNorm_2
+            which = int(parts[3])
+            mod = (block, "Conv_2") if which == 0 else (block, "BatchNorm_2")
+            sub = "conv" if which == 0 else "bn"
+        elif parts[2].startswith("conv"):
+            mod = (block, f"Conv_{int(parts[2].removeprefix('conv')) - 1}")
+        elif parts[2].startswith("norm"):
+            mod = (block, f"BatchNorm_{int(parts[2].removeprefix('norm')) - 1}")
+        else:
+            raise KeyError(f"unhandled encoder key {'.'.join(parts)}")
+    else:
+        raise KeyError(f"unhandled encoder key {'.'.join(parts)}")
+
+    is_conv = mod[-1].startswith("Conv")
+    if is_conv:
+        if leaf == "weight":
+            return "params", mod + ("kernel",), value.transpose(2, 3, 1, 0)
+        return "params", mod + ("bias",), value
+    if leaf == "weight":
+        return "params", mod + ("scale",), value
+    if leaf == "bias":
+        return "params", mod + ("bias",), value
+    if leaf == "running_mean":
+        return "batch_stats", mod + ("mean",), value
+    if leaf == "running_var":
+        return "batch_stats", mod + ("var",), value
+    raise KeyError(f"unhandled encoder leaf {'.'.join(parts)}")
+
+
+def _block_has_downsample(state_dict, prefix: str) -> bool:
+    return any(k.startswith(prefix + ".downsample.") for k in state_dict)
+
+
+def convert_raft_state_dict(state_dict: Mapping[str, Any],
+                            small: bool = False) -> Dict[str, Any]:
+    """Reference RAFT state_dict (raft_1..raft_5 family, optional
+    'module.' prefix) -> our flax variables.
+
+    Handles fnet/cnet/efnet/ecnet encoders, the shared update block (full
+    or small), and an embedded DexiNed (v4/v5) under its 'dexined.'
+    prefix.
+    """
+    state_dict = {k.removeprefix("module."): v for k, v in state_dict.items()}
+    out: Dict[str, Any] = {"params": {}, "batch_stats": {}}
+
+    dexined_sub = {k.removeprefix("dexined."): v for k, v in state_dict.items()
+                   if k.startswith("dexined.")}
+    if dexined_sub:
+        dx = convert_dexined_state_dict(dexined_sub)
+        out["params"]["DexiNed_0"] = dx["params"]
+        out["batch_stats"]["DexiNed_0"] = dx["batch_stats"]
+
+    ub_map = _UPDATE_BLOCK_SMALL if small else _UPDATE_BLOCK_FULL
+    ub_root = ("ScanRAFTStep_0",
+               "SmallUpdateBlock_0" if small else "BasicUpdateBlock_0")
+
+    for key, raw in state_dict.items():
+        if key.startswith("dexined.") or key.endswith("num_batches_tracked"):
+            continue
+        value = _to_numpy(raw).astype(np.float32)
+        parts = key.split(".")
+        root = parts[0]
+
+        if root in ("fnet", "cnet", "efnet", "ecnet"):
+            # skip the bare normK that aliases downsample.1 (the reference
+            # registers the same BN module under both names)
+            if (parts[1].startswith("layer")
+                    and parts[3].startswith("norm")
+                    and _block_has_downsample(state_dict,
+                                              ".".join(parts[:3]))
+                    and parts[3] == _last_norm(state_dict, ".".join(parts[:3]))):
+                continue
+            coll, path, conv = _convert_encoder_key(parts[1:], value)
+            _set(out[coll], (root,) + path, conv)
+            continue
+
+        if root == "update_block":
+            sub = ".".join(parts[1:-1])
+            leaf = parts[-1]
+            if sub not in ub_map:
+                raise KeyError(f"unhandled update_block key {key!r}")
+            mod = ub_root + ub_map[sub]
+            if leaf == "weight":
+                _set(out["params"], mod + ("kernel",),
+                     value.transpose(2, 3, 1, 0))
+            else:
+                _set(out["params"], mod + ("bias",), value)
+            continue
+
+        raise KeyError(f"unknown RAFT root module {root!r} in {key!r}")
+    if not out["batch_stats"]:
+        out["batch_stats"] = {}
+    return out
+
+
+def _last_norm(state_dict, block_prefix: str) -> str:
+    """Highest-numbered normK inside a residual block (the one the
+    reference aliases into downsample.1)."""
+    norms = set()
+    for k in state_dict:
+        if k.startswith(block_prefix + ".norm"):
+            norms.add(k[len(block_prefix) + 1:].split(".")[0])
+    return max(norms) if norms else ""
+
+
+def load_raft_pth(path: str, small: bool = False,
+                  verify_template=None) -> Dict[str, Any]:
+    """Load a reference RAFT .pth (DataParallel-prefixed per
+    evaluate.py:221-222) and convert."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu")
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    converted = convert_raft_state_dict(sd, small=small)
+    if verify_template is not None:
+        verify_against(verify_template, converted)
+    return converted
+
+
+def load_dexined_pth(path: str, verify_template=None) -> Dict[str, Any]:
+    """Load a reference DexiNed .pth and convert; strips an optional
+    'module.' DataParallel prefix (evaluate.py:221-222 convention)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu")
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    sd = {k.removeprefix("module."): v for k, v in sd.items()}
+    converted = convert_dexined_state_dict(sd)
+    if verify_template is not None:
+        verify_against(verify_template, converted)
+    return converted

@@ -100,16 +100,18 @@ def build_corr_pyramid(
 
 
 def _window_delta(radius: int, dtype=jnp.float32) -> jax.Array:
-    """(2r+1, 2r+1, 2) integer offset lattice, channels (dx, dy).
+    """(2r+1, 2r+1, 2) offset lattice, channels (x-offset, y-offset).
 
-    The reference builds its lattice with meshgrid(dy, dx) (core/corr.py:37-43)
-    which transposes the window axes; since the window is a symmetric square
-    feeding learned layers, only internal consistency matters — we use the
-    natural orientation (x varies along axis 1).
+    Matches the reference's ordering EXACTLY (core/corr.py:37-43): it
+    stacks meshgrid(dy, dx) onto (x, y) centroids, so the x offset varies
+    along window axis 0 and the y offset along axis 1 (a transposed
+    window). Bit-compatibility here is what lets reference-trained
+    checkpoints load via interop.torch_convert — the motion encoder's
+    first conv consumes these 324 channels in this order.
     """
     d = jnp.arange(-radius, radius + 1, dtype=dtype)
-    dyy, dxx = jnp.meshgrid(d, d, indexing="ij")
-    return jnp.stack([dxx, dyy], axis=-1)
+    di, dj = jnp.meshgrid(d, d, indexing="ij")  # di varies along axis 0
+    return jnp.stack([di, dj], axis=-1)  # (x + di, y + dj)
 
 
 def corr_lookup(pyramid: CorrPyramid, coords: jax.Array) -> jax.Array:

@@ -126,7 +126,11 @@ def _local_corr_dense(
     br = lattice[..., 1:win + 1, 1:win + 1]
     out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
            + fy * (1 - fx) * bl + fy * fx * br)
-    return out.reshape(b, h, w, win * win)
+    # lattice axes are (y-offset, x-offset); the reference channel order
+    # has the x offset on the SLOW axis (transposed window,
+    # core/corr.py:37-43 — see ops.corr._window_delta), so swap before
+    # flattening to stay bit-compatible with the allpairs path
+    return out.swapaxes(-2, -1).reshape(b, h, w, win * win)
 
 
 @flax.struct.dataclass

@@ -79,7 +79,8 @@ def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
     br = lattice[:, 1:win + 1, 1:win + 1]
     out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
            + fy * (1 - fx) * bl + fy * fx * br)
-    out_ref[0] = out.reshape(p_block, win * win)
+    # x offset on the slow axis (reference channel order — ops.corr)
+    out_ref[0] = out.swapaxes(1, 2).reshape(p_block, win * win)
 
 
 def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,

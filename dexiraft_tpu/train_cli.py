@@ -109,8 +109,6 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
     # None = "not given": keep the preset's per-stage name/validation
     if args.name is not None:
         overrides["name"] = args.name
-    elif args.preset == "none":
-        overrides["name"] = "raft"
     if args.validation is not None:
         overrides["validation"] = tuple(args.validation)
     for field, value in [("lr", args.lr), ("num_steps", args.num_steps),

@@ -12,10 +12,11 @@ import torch.nn.functional as F  # noqa: E402
 
 
 class TorchCorrBlock:
-    """Reference CorrBlock (core/corr.py), with the natural (dx, dy) window
-    orientation used by our implementation (the reference's meshgrid(dy, dx)
-    transposes the window — a learned-layer-internal permutation, see
-    dexiraft_tpu/ops/corr.py:_window_delta)."""
+    """Reference CorrBlock (core/corr.py) including its transposed window
+    ordering (meshgrid(dy, dx) stacked onto (x, y) centroids,
+    core/corr.py:37-43) — our implementation matches it bit-for-bit so
+    reference-trained checkpoints load (see ops/corr.py:_window_delta and
+    tests/test_torch_interop.py for the real-reference check)."""
 
     def __init__(self, fmap1, fmap2, num_levels=4, radius=4):
         self.num_levels = num_levels
@@ -38,8 +39,9 @@ class TorchCorrBlock:
         out = []
         for i, corr in enumerate(self.pyramid):
             d = torch.linspace(-r, r, 2 * r + 1)
-            dyy, dxx = torch.meshgrid(d, d, indexing="ij")
-            delta = torch.stack([dxx, dyy], dim=-1)  # (win, win, 2) as (dx, dy)
+            di, dj = torch.meshgrid(d, d, indexing="ij")
+            # reference ordering: axis-0 offset added to x, axis-1 to y
+            delta = torch.stack([di, dj], dim=-1)
             centroid = coords.reshape(b * h * w, 1, 1, 2) / 2**i
             coords_lvl = centroid + delta.view(1, 2 * r + 1, 2 * r + 1, 2)
 
