@@ -4,8 +4,9 @@ import sys
 
 
 def main() -> None:
-    if len(sys.argv) < 2 or sys.argv[1] not in ("train", "eval", "dexined"):
-        print("usage: python -m dexiraft_tpu {train,eval,dexined} [args...]",
+    cmds = ("train", "eval", "dexined", "viz")
+    if len(sys.argv) < 2 or sys.argv[1] not in cmds:
+        print(f"usage: python -m dexiraft_tpu {{{','.join(cmds)}}} [args...]",
               file=sys.stderr)
         raise SystemExit(2)
     cmd, argv = sys.argv[1], sys.argv[2:]
@@ -13,6 +14,8 @@ def main() -> None:
         from dexiraft_tpu.train_cli import main as run
     elif cmd == "eval":
         from dexiraft_tpu.eval_cli import main as run
+    elif cmd == "viz":
+        from dexiraft_tpu.viz_cli import main as run
     else:
         from dexiraft_tpu.dexined_cli import main as run
     run(argv)

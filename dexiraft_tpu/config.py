@@ -36,6 +36,10 @@ class RAFTConfig:
     # rows per chunk for the local path's gather (bounds the transient
     # patch buffer to rows*W*(2r+2)^2*C floats; None = whole frame at once)
     corr_row_chunk: Optional[int] = 8
+    # rematerialize each refinement iteration in the backward pass:
+    # activations of the scanned step are recomputed instead of stored,
+    # trading FLOPs for HBM (jax.checkpoint over the scan body)
+    remat: bool = False
 
     @property
     def radius(self) -> int:

@@ -317,8 +317,27 @@ class EdgePairDataset(FlowDataset):
 
 
 def fetch_dataset(stage: str, image_size: Sequence[int],
-                  train_ds: str = "C+T+K+S+H"):
-    """Stage-keyed training mixture (core/datasets.py:202-237)."""
+                  train_ds: str = "C+T+K+S+H",
+                  edge_root: Optional[str] = None):
+    """Stage-keyed training mixture (core/datasets.py:202-237).
+
+    edge_root: parallel tree of precomputed edge-map PNGs — wraps the
+    stage dataset in EdgePairDataset for the v2/v3 data-edge contract
+    (core/datasets_seperate.py). Supported for the single-dataset stages
+    (chairs, kitti)."""
+    ds = _fetch_plain(stage, image_size, train_ds)
+    if edge_root is None:
+        return ds
+    if isinstance(ds, ConcatFlowDataset):
+        raise ValueError(
+            f"edge_root is only supported for single-dataset stages, "
+            f"not the {stage!r} mixture")
+    image_root = osp.dirname(osp.commonprefix(
+        [p for pair in ds.image_list for p in pair]))
+    return EdgePairDataset.from_parallel_tree(ds, image_root, edge_root)
+
+
+def _fetch_plain(stage: str, image_size: Sequence[int], train_ds: str):
     if stage == "chairs":
         aug = dict(crop_size=image_size, min_scale=-0.1, max_scale=1.0, do_flip=True)
         return FlyingChairs(aug, split="training")

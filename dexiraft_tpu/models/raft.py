@@ -222,13 +222,21 @@ class RAFT(nn.Module):
                 epyr=epyr,
             )
 
+        step_cls = RAFTStep
+        if cfg.remat:
+            # recompute each iteration's activations in backward instead
+            # of storing iters x (GRU state + corr features) in HBM
+            step_cls = nn.remat(RAFTStep, prevent_cse=False)
         scan = nn.scan(
-            RAFTStep,
+            step_cls,
             variable_broadcast="params",
             split_rngs={"params": False},
             length=iters,
         )
-        carry, predictions = scan(cfg=cfg, dtype=dtype)(carry, None)
+        # pin the module name so parameter paths (and thus checkpoints and
+        # interop name maps) are identical with and without remat
+        carry, predictions = scan(cfg=cfg, dtype=dtype,
+                                  name="ScanRAFTStep_0")(carry, None)
 
         if test_mode:
             flow_low = carry["coords1"] - coords0

@@ -1,0 +1,65 @@
+"""Profiling / tracing (SURVEY.md §5 — absent in the reference, where the
+only timing is DexiNed's per-image time.time() deltas, main.py:133-147).
+
+Two tools:
+  * trace(log_dir): context manager around jax.profiler for a window of
+    steps — inspect with TensorBoard's profile plugin or Perfetto.
+  * StepTimer: wall-clock per-step timing with warmup exclusion; the
+    train Logger separately reports steps/sec and iters/sec (the
+    north-star throughput metric).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profiler trace into log_dir."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing; ignores the first `warmup` laps (compile)."""
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self.times: list = []
+        self._t: Optional[float] = None
+        self._laps = 0
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t
+        self._laps += 1
+        if self._laps > self.warmup:
+            self.times.append(dt)
+        return False
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    def summary(self) -> str:
+        if not self.times:
+            return "no timed laps"
+        lo, hi = min(self.times), max(self.times)
+        return (f"{len(self.times)} laps: mean {self.mean * 1e3:.2f} ms "
+                f"(min {lo * 1e3:.2f}, max {hi * 1e3:.2f})")
+
+
+def annotate(name: str):
+    """Named region for profile traces (shows up in the trace viewer)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--corr_impl", default="allpairs",
                    choices=["allpairs", "local", "pallas"])
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize refinement iterations in backward "
+                        "(HBM savings at ~1 extra forward of FLOPs)")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--num_steps", type=int, default=None)
@@ -65,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--validation", nargs="*", default=None,
                    choices=sorted(_VAL_ITERS),
                    help="default: the preset's per-stage validation sets")
+    p.add_argument("--edge_root", default=None,
+                   help="parallel tree of precomputed edge-map PNGs for the "
+                        "v2/v3 data-edge contract (core/datasets_seperate.py)")
     p.add_argument("--restore_ckpt", default=None,
                    help="orbax dir for partial (strict=False-style) restore")
     p.add_argument("--resume", action="store_true",
@@ -76,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sum_freq", type=int, default=100)
     p.add_argument("--num_workers", type=int, default=4)
     p.add_argument("--log_dir", default="runs")
+    p.add_argument("--profile_steps", type=int, nargs=2, default=None,
+                   metavar=("START", "STOP"),
+                   help="capture a jax.profiler trace for steps "
+                        "[START, STOP) into <log_dir>/<name>/profile")
     return p
 
 
@@ -85,6 +95,7 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         mixed_precision=args.mixed_precision,
         dropout=args.dropout,
         corr_impl=args.corr_impl,
+        remat=args.remat,
     )
 
     if args.preset != "none":
@@ -177,7 +188,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         print(f"Partial restore from {args.restore_ckpt} "
               f"({len(skipped)} leaves fresh)")
 
-    dataset = fetch_dataset(tc.stage, tc.image_size)
+    dataset = fetch_dataset(tc.stage, tc.image_size,
+                            edge_root=args.edge_root)
     print(f"Training with {len(dataset)} image pairs")
     loader = Loader(
         dataset, tc.batch_size, seed=tc.seed, num_workers=args.num_workers,
@@ -189,12 +201,26 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     validate = _make_validators(cfg, tc.validation,
                                 lambda: state.variables)
 
+    prof_start, prof_stop = args.profile_steps or (-1, -1)
+    prof_dir = osp.join(args.log_dir, tc.name, "profile")
+    prof_active = False
+
     total_steps = int(state.step)
     with mesh:
         for batch in loader:
+            # range-based (not equality) so resumed runs landing inside
+            # the window still profile, and stop only pairs with a start
+            if (not prof_active and prof_start <= total_steps < prof_stop):
+                jax.profiler.start_trace(prof_dir)
+                prof_active = True
             state, metrics = step_fn(state, shard_batch(batch, mesh))
             total_steps += 1
             logger.push(metrics)
+            if prof_active and total_steps >= prof_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                prof_active = False
+                print(f"[profile] trace -> {prof_dir}")
 
             if total_steps % tc.val_freq == 0:
                 ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
@@ -203,6 +229,9 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
             if total_steps >= tc.num_steps:
                 break
 
+    if prof_active:  # window extended past the last step: finalize
+        jax.profiler.stop_trace()
+        print(f"[profile] trace (truncated at end of run) -> {prof_dir}")
     ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
     logger.close()
     print(f"Done: {total_steps} steps -> {ckpt_dir}")

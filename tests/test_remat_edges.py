@@ -1,0 +1,81 @@
+"""Remat option and the edge-pair (v2/v3 data-edge) training path."""
+
+import numpy as np
+import pytest
+
+from dexiraft_tpu.data.flow_io import write_flo
+
+
+class TestRemat:
+    def test_remat_matches_plain(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.config import raft_v1
+        from dexiraft_tpu.models.raft import RAFT
+
+        img = jax.random.uniform(jax.random.PRNGKey(1), (1, 64, 64, 3),
+                                 jnp.float32, 0, 255)
+        outs = {}
+        for remat in (False, True):
+            cfg = raft_v1(small=True, remat=remat)
+            model = RAFT(cfg)
+            variables = model.init(jax.random.PRNGKey(0), img, img,
+                                   iters=1, train=False)
+
+            def loss(v):
+                preds = model.apply(v, img, img, iters=3, train=False)
+                return jnp.sum(preds ** 2)
+
+            outs[remat] = (float(loss(variables)),
+                           jax.tree.leaves(jax.grad(loss)(variables))[0])
+        np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[True][1]),
+                                   np.asarray(outs[False][1]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.fixture()
+def chairs_with_edges(tmp_path, monkeypatch):
+    import imageio.v2 as imageio
+
+    root = tmp_path / "FlyingChairs_release"
+    data = root / "data"
+    edges = tmp_path / "edges"
+    data.mkdir(parents=True)
+    edges.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        for suffix in ("img1", "img2"):
+            img = rng.integers(0, 256, (96, 128, 3), dtype=np.uint8)
+            imageio.imwrite(data / f"{i:05d}_{suffix}.ppm", img)
+            imageio.imwrite(edges / f"{i:05d}_{suffix}.png", img)
+        write_flo(data / f"{i:05d}_flow.flo",
+                  rng.normal(size=(96, 128, 2)).astype(np.float32))
+    (root / "chairs_split.txt").write_text("\n".join(["1"] * 4))
+    monkeypatch.setenv("DEXIRAFT_DATA_DIR", str(tmp_path))
+    return tmp_path, str(edges)
+
+
+class TestEdgePairPath:
+    def test_fetch_dataset_with_edge_root(self, chairs_with_edges):
+        from dexiraft_tpu.data.datasets import fetch_dataset
+
+        _, edge_root = chairs_with_edges
+        ds = fetch_dataset("chairs", (64, 64), edge_root=edge_root)
+        s = ds.sample(0, np.random.default_rng(0))
+        assert s["edges1"].shape == (64, 64, 3)
+        assert s["image1"].shape == (64, 64, 3)
+
+    def test_v2_training_through_cli(self, chairs_with_edges, monkeypatch):
+        from dexiraft_tpu.train_cli import main
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        tmp, edge_root = chairs_with_edges
+        monkeypatch.chdir(tmp)
+        main(["--name", "e", "--stage", "chairs", "--variant", "v2",
+              "--small", "--num_steps", "2", "--batch_size", "2",
+              "--image_size", "64", "64", "--iters", "2",
+              "--num_workers", "1", "--edge_root", edge_root,
+              "--output", str(tmp / "ck"), "--log_dir", str(tmp / "runs")])
+        assert ckpt.latest_step(str(tmp / "ck" / "e")) == 2
