@@ -207,17 +207,22 @@ _UPDATE_BLOCK_SMALL = {
 }
 
 
-def _convert_encoder_key(parts, value):
+def _convert_encoder_key(parts, value, small: bool = False):
     """BasicEncoder/SmallEncoder names -> our extractor module paths.
 
     Stem: conv1 -> Conv_0, norm1 -> BatchNorm_0 (batch-norm encoders only;
     instance norm is parameter-free on both sides), conv2 -> Conv_1.
-    layer{L}.{j} -> ResidualBlock/BottleneckBlock_{2(L-1)+j}: convN ->
-    Conv_{N-1}, normN -> BatchNorm_{N-1}, downsample.0 -> shortcut conv,
-    downsample.1 -> shortcut BN. The bare normK that aliases downsample.1
-    (reference registers the same module twice, extractor.py) is skipped
-    by the caller when a downsample exists in the same block.
+    layer{L}.{j} -> ResidualBlock_{2(L-1)+j} (full) or
+    BottleneckBlock_{...} (small): convN -> Conv_{N-1}, normN ->
+    BatchNorm_{N-1}, downsample.0 -> shortcut conv (Conv_2 residual /
+    Conv_3 bottleneck), downsample.1 -> shortcut BN. The bare normK that
+    aliases downsample.1 (reference registers the same module twice,
+    extractor.py) is skipped by the caller when a downsample exists in
+    the same block.
     """
+    block_cls = "BottleneckBlock" if small else "ResidualBlock"
+    shortcut_conv = "Conv_3" if small else "Conv_2"
+    shortcut_bn = "BatchNorm_3" if small else "BatchNorm_2"
     sub, leaf = parts[-2], parts[-1]
     if parts[0] == "conv1":
         mod = ("Conv_0",)
@@ -227,12 +232,11 @@ def _convert_encoder_key(parts, value):
         mod = ("BatchNorm_0",)
     elif parts[0].startswith("layer"):
         layer = int(parts[0].removeprefix("layer"))
-        block = f"ResidualBlock_{2 * (layer - 1) + int(parts[1])}"
+        block = f"{block_cls}_{2 * (layer - 1) + int(parts[1])}"
         if sub == "downsample" or parts[2] == "downsample":
-            # conv-only blocks use Conv_2 for the shortcut; normed blocks
-            # Conv_2 + BatchNorm_2
             which = int(parts[3])
-            mod = (block, "Conv_2") if which == 0 else (block, "BatchNorm_2")
+            mod = ((block, shortcut_conv) if which == 0
+                   else (block, shortcut_bn))
             sub = "conv" if which == 0 else "bn"
         elif parts[2].startswith("conv"):
             mod = (block, f"Conv_{int(parts[2].removeprefix('conv')) - 1}")
@@ -302,7 +306,8 @@ def convert_raft_state_dict(state_dict: Mapping[str, Any],
                                               ".".join(parts[:3]))
                     and parts[3] == _last_norm(state_dict, ".".join(parts[:3]))):
                 continue
-            coll, path, conv = _convert_encoder_key(parts[1:], value)
+            coll, path, conv = _convert_encoder_key(parts[1:], value,
+                                                    small=small)
             _set(out[coll], (root,) + path, conv)
             continue
 

@@ -1,6 +1,8 @@
-"""Numerical parity: reference torch DexiNed vs our flax DexiNed under
+"""Numerical parity: reference torch models vs our flax models under
 converted weights — validates every conversion rule (conv transpose
-orientation, BN stats, block name map) end to end.
+orientation, BN stats routing, block name maps) and the forward numerics
+(encoders, correlation pyramid + bilinear lookup, ConvGRU update, convex
+upsampling; SURVEY.md §7 hard parts 2 and 4) end to end.
 
 Skipped when the reference checkout or torch is unavailable.
 """
@@ -12,28 +14,38 @@ import numpy as np
 import pytest
 
 _REF = "/root/reference/core/DexiNed"
+_REF_CORE = "/root/reference/core"
 
 torch = pytest.importorskip("torch")
 pytestmark = pytest.mark.skipif(not os.path.isdir(_REF),
                                 reason="reference checkout not mounted")
 
 
-def _reference_model():
-    sys.path.insert(0, _REF)
+def _import_from(path, module):
+    sys.path.insert(0, path)
     try:
-        from model import DexiNed as TorchDexiNed
+        return __import__(module)
     finally:
-        sys.path.remove(_REF)
-    torch.manual_seed(0)
-    m = TorchDexiNed()
-    m.eval()
-    # randomize BN stats so the parity test actually exercises them
+        sys.path.remove(path)
+
+
+def _randomize_bn_stats(model):
+    """Fresh-init BN buffers are all (0, 1); randomize so a converter that
+    routes stats to the wrong same-shaped module fails the test."""
     with torch.no_grad():
-        for name, buf in m.named_buffers():
+        for name, buf in model.named_buffers():
             if name.endswith("running_mean"):
                 buf.normal_(0, 0.05)
             elif name.endswith("running_var"):
                 buf.uniform_(0.5, 1.5)
+
+
+def _reference_model():
+    TorchDexiNed = _import_from(_REF, "model").DexiNed
+    torch.manual_seed(0)
+    m = TorchDexiNed()
+    m.eval()
+    _randomize_bn_stats(m)
     return m
 
 
@@ -62,8 +74,6 @@ def parity_pair():
 def test_full_model_parity(parity_pair):
     import jax.numpy as jnp
 
-    from dexiraft_tpu.models.dexined import DexiNed
-
     tm, jm, variables = parity_pair
     rng = np.random.default_rng(0)
     x = rng.normal(0, 1, (1, 96, 128, 3)).astype(np.float32)
@@ -80,81 +90,6 @@ def test_full_model_parity(parity_pair):
             err_msg=f"output {i} diverges")
 
 
-class TestRAFTParity:
-    """End-to-end RAFT forward parity with the reference torch model under
-    converted weights — validates the encoders, correlation pyramid,
-    bilinear lookup, ConvGRU update, and convex upsampling numerics in one
-    shot (SURVEY.md §7 hard parts 2 and 4)."""
-
-    @pytest.fixture(scope="class")
-    def raft_pair(self):
-        import argparse
-
-        import jax
-        import jax.numpy as jnp
-
-        from dexiraft_tpu.config import raft_v1
-        from dexiraft_tpu.interop.torch_convert import (
-            convert_raft_state_dict,
-            verify_against,
-        )
-        from dexiraft_tpu.models.raft import RAFT
-
-        ref_core = "/root/reference/core"
-        sys.path.insert(0, ref_core)
-        try:
-            from raft_1 import RAFT as TorchRAFT
-        finally:
-            sys.path.remove(ref_core)
-
-        torch.manual_seed(0)
-        args = argparse.Namespace(small=False, dropout=0.0,
-                                  mixed_precision=False, alternate_corr=False)
-        tm = TorchRAFT(args)
-        tm.eval()
-        with torch.no_grad():  # exercise BN stats, not just init values
-            for name, buf in tm.named_buffers():
-                if name.endswith("running_mean"):
-                    buf.normal_(0, 0.05)
-                elif name.endswith("running_var"):
-                    buf.uniform_(0.5, 1.5)
-
-        variables = convert_raft_state_dict(tm.state_dict())
-        jm = RAFT(raft_v1())
-        template = jax.eval_shape(
-            lambda: jm.init(jax.random.PRNGKey(0),
-                            jnp.zeros((1, 64, 64, 3)),
-                            jnp.zeros((1, 64, 64, 3)), iters=1, train=False))
-        verify_against(template, variables)
-        return tm, jm, variables
-
-    def test_forward_parity(self, raft_pair):
-        import jax.numpy as jnp
-
-        tm, jm, variables = raft_pair
-        rng = np.random.default_rng(1)
-        # frames large enough that the level-3 volume is >= 2x2 — at 1x1
-        # the REFERENCE's grid_sample normalization divides by zero
-        # (core/utils/utils.py:64-65) and emits NaN
-        im1 = rng.uniform(0, 255, (1, 128, 160, 3)).astype(np.float32)
-        im2 = rng.uniform(0, 255, (1, 128, 160, 3)).astype(np.float32)
-
-        with torch.no_grad():
-            t1 = torch.from_numpy(im1.transpose(0, 3, 1, 2))
-            t2 = torch.from_numpy(im2.transpose(0, 3, 1, 2))
-            t_low, t_up = tm(t1, t2, iters=4, test_mode=True)
-
-        j_low, j_up = jm.apply(variables, jnp.asarray(im1), jnp.asarray(im2),
-                               iters=4, train=False, test_mode=True)
-
-        np.testing.assert_allclose(
-            np.asarray(j_low), t_low.numpy().transpose(0, 2, 3, 1),
-            rtol=5e-3, atol=5e-3)
-        np.testing.assert_allclose(
-            np.asarray(j_up), t_up.numpy().transpose(0, 2, 3, 1),
-            rtol=5e-3, atol=5e-3)
-
-
 def test_stacked_edge_maps_shape(parity_pair):
     import jax.numpy as jnp
 
@@ -164,3 +99,90 @@ def test_stacked_edge_maps_shape(parity_pair):
     x = jnp.zeros((2, 64, 64, 3))
     maps = stack_edge_maps(jm.apply(variables, x, train=False))
     assert maps.shape == (2, 64, 64, 7)
+
+
+def _raft_parity_case(torch_model, cfg, *, small=False, seed=1, tol=5e-3):
+    """Shared harness: convert weights, verify the tree, compare the
+    test-mode forward (both low- and full-resolution flow) at 128x160 —
+    frames large enough that the level-3 volume is >= 2x2; at 1x1 the
+    REFERENCE's grid_sample normalization divides by zero
+    (core/utils/utils.py:64-65) and emits NaN."""
+    import jax
+    import jax.numpy as jnp
+
+    from dexiraft_tpu.interop.torch_convert import (
+        convert_raft_state_dict,
+        verify_against,
+    )
+    from dexiraft_tpu.models.raft import RAFT
+
+    torch_model.eval()
+    _randomize_bn_stats(torch_model)
+
+    variables = convert_raft_state_dict(torch_model.state_dict(), small=small)
+    jm = RAFT(cfg)
+    template = jax.eval_shape(
+        lambda: jm.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 128, 160, 3)),
+                        jnp.zeros((1, 128, 160, 3)), iters=1, train=False))
+    verify_against(template, variables)
+
+    rng = np.random.default_rng(seed)
+    im1 = rng.uniform(0, 255, (1, 128, 160, 3)).astype(np.float32)
+    im2 = rng.uniform(0, 255, (1, 128, 160, 3)).astype(np.float32)
+
+    with torch.no_grad():
+        t_low, t_up = torch_model(
+            torch.from_numpy(im1.transpose(0, 3, 1, 2)),
+            torch.from_numpy(im2.transpose(0, 3, 1, 2)),
+            iters=4, test_mode=True)
+    j_low, j_up = jm.apply(variables, jnp.asarray(im1), jnp.asarray(im2),
+                           iters=4, train=False, test_mode=True)
+
+    np.testing.assert_allclose(
+        np.asarray(j_low), t_low.numpy().transpose(0, 2, 3, 1),
+        rtol=tol, atol=tol)
+    np.testing.assert_allclose(
+        np.asarray(j_up), t_up.numpy().transpose(0, 2, 3, 1),
+        rtol=tol, atol=tol)
+
+
+def _v1_args(small):
+    import argparse
+
+    return argparse.Namespace(small=small, dropout=0.0,
+                              mixed_precision=False, alternate_corr=False)
+
+
+class TestRAFTParity:
+    def test_full_model(self):
+        from dexiraft_tpu.config import raft_v1
+
+        TorchRAFT = _import_from(_REF_CORE, "raft_1").RAFT
+        torch.manual_seed(0)
+        _raft_parity_case(TorchRAFT(_v1_args(False)), raft_v1(), seed=1)
+
+    def test_small_model(self):
+        from dexiraft_tpu.config import raft_v1
+
+        TorchRAFT = _import_from(_REF_CORE, "raft_1").RAFT
+        torch.manual_seed(1)
+        _raft_parity_case(TorchRAFT(_v1_args(True)), raft_v1(small=True),
+                          small=True, seed=2)
+
+    def test_v5_dual_stream(self, monkeypatch):
+        """Flagship v5: embedded frozen DexiNed, dual streams, shared
+        update block, coupled delta-f + delta-ef update (core/raft.py:183)."""
+        from dexiraft_tpu.config import raft_v5
+
+        # the reference RAFT.__init__ loads a DexiNed checkpoint from disk
+        # (core/raft.py:30-33) that ships outside the repo — feed it a
+        # randomly initialized DexiNed state dict instead
+        TorchDexiNed = _import_from(_REF, "model").DexiNed
+        torch.manual_seed(3)
+        dexi_sd = TorchDexiNed().state_dict()
+        monkeypatch.setattr(torch, "load", lambda *a, **k: dexi_sd)
+
+        TorchRAFTv5 = _import_from(_REF_CORE, "raft").RAFT
+        tm = TorchRAFTv5(_v1_args(False))
+        _raft_parity_case(tm, raft_v5(), seed=4, tol=1e-2)
