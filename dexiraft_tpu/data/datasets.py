@@ -148,12 +148,17 @@ class MpiSintel(FlowDataset):
     """Sintel scene walk, clean/final passes (core/datasets.py:103-120)."""
 
     def __init__(self, aug_params=None, split="training", root=None,
-                 dstype="clean", scene: Optional[str] = None):
+                 dstype="clean", scene: Optional[str] = None,
+                 qualitative: bool = False):
+        """scene restricts to one scene; qualitative=True additionally
+        returns test-style samples (image pair + extra_info, no flow) for
+        visualization runs on training scenes — the reference's
+        core/datasets_sub.py market_2 workflow."""
         super().__init__(aug_params)
         root = root or data_root("Sintel")
         flow_root = osp.join(root, split, "flow")
         image_root = osp.join(root, split, dstype)
-        if split == "test":
+        if split == "test" or qualitative:
             self.is_test = True
         scenes = [scene] if scene else sorted(os.listdir(image_root))
         for sc in scenes:

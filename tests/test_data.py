@@ -196,6 +196,12 @@ class TestDatasets:
         one = MpiSintel(None, split="training", root=str(tmp_path),
                         dstype="clean", scene="market_2")
         assert len(one) == 2
+        # qualitative single-scene mode (core/datasets_sub.py): test-style
+        # samples from a training scene for visualization runs
+        q = MpiSintel(None, split="training", root=str(tmp_path),
+                      dstype="clean", scene="market_2", qualitative=True)
+        s = q.sample(0)
+        assert "flow" not in s and s["extra_info"] == ("market_2", 0)
 
     def test_kitti_sparse(self, tmp_path):
         import imageio.v2 as imageio
