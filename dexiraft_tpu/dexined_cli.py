@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--steps_per_epoch", type=int, default=None,
                    help="cap batches per epoch (default: full dataset)")
+    p.add_argument("--gt_root", default=None,
+                   help="ground-truth edge-map dir: --test additionally "
+                        "reports ODS/OIS/AP (dexined.metrics)")
     return p
 
 
@@ -153,6 +156,7 @@ def test(args) -> None:
         return jax.nn.sigmoid(preds[-1])  # fused map
 
     total, times = 0, []
+    counts, gt_missing = [], []
     for i in range(len(dataset)):
         s = dataset.sample(i)
         t0 = time.perf_counter()
@@ -162,11 +166,43 @@ def test(args) -> None:
         times.append(dt)
         save_edge_maps(fused, [s["file_name"]], [s["image_shape"]],
                        osp.join(args.output_dir, args.dataset))
+        if args.gt_root:
+            import cv2
+
+            from dexiraft_tpu.dexined.metrics import edge_counts
+
+            stem = osp.splitext(s["file_name"])[0]
+            gt = cv2.imread(osp.join(args.gt_root, stem + ".png"),
+                            cv2.IMREAD_GRAYSCALE)
+            if gt is None:
+                gt_missing.append(s["file_name"])
+            else:
+                # score at the GT's native resolution: upsample the
+                # probability map rather than downscaling the GT, which
+                # would interpolate away its 1-px edges
+                pred_full = cv2.resize(fused[0, ..., 0],
+                                       (gt.shape[1], gt.shape[0]))
+                # streaming: only the (T, 4) counts are kept per image
+                counts.append(edge_counts(pred_full, gt > 127))
         total += 1
         print(f"{s['file_name']}: {dt * 1e3:.1f} ms")
     if times:
         print(f"Mean inference time over {total} images "
               f"(first excluded): {np.mean(times[1:] or times) * 1e3:.1f} ms")
+    if args.gt_root:
+        if gt_missing:
+            print(f"[metrics] WARNING: no GT found for {len(gt_missing)}/"
+                  f"{total} images (e.g. {gt_missing[0]!r}) under "
+                  f"{args.gt_root}")
+        if counts:
+            from dexiraft_tpu.dexined.metrics import evaluate_from_counts
+
+            res = evaluate_from_counts(counts)
+            print(f"ODS: {res['ODS']:.4f}  OIS: {res['OIS']:.4f}  "
+                  f"AP: {res['AP']:.4f}  ({len(counts)} images)")
+        else:
+            print(f"[metrics] no GT matched under {args.gt_root}; "
+                  "expected <gt_root>/<image stem>.png")
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,59 @@
+"""Multi-host initialization.
+
+The reference's parallelism is single-process DataParallel (SURVEY.md
+§2.7) — it has no multi-node story at all. Here multi-host is the same
+code path as single-host: call initialize() once per process before any
+jax usage, build a mesh over jax.devices() (which enumerates EVERY chip
+in the slice, all hosts), and the sharded train step's collectives ride
+ICI; DCN only enters for multi-slice meshes.
+
+Per-host data loading is already process-aware (Loader's
+process_index/process_count slices the global batch), so no further
+changes are needed for multi-host training.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """jax.distributed.initialize with env-var defaults; called by the
+    train CLI before any jax usage.
+
+    Modes:
+      * explicit: coordinator_address given (arg or
+        JAX_COORDINATOR_ADDRESS) + num_processes/process_id (args or
+        JAX_NUM_PROCESSES / JAX_PROCESS_ID);
+      * auto-bootstrap: JAX_AUTO_DISTRIBUTED=1 -> no-arg
+        jax.distributed.initialize() (TPU pods self-discover);
+      * otherwise: no-op (single process — one host owning all chips).
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator_address is None:
+        if os.environ.get("JAX_AUTO_DISTRIBUTED") == "1":
+            jax.distributed.initialize()
+        return
+    num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None \
+        else _env_int("JAX_PROCESS_ID")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _env_int(name: str) -> int:
+    value = os.environ.get(name)
+    if value is None:
+        raise ValueError(
+            f"multi-host init: coordinator address was given but {name} "
+            "is not set (and no explicit argument was passed)")
+    return int(value)

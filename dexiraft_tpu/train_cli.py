@@ -238,6 +238,9 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
 
 
 def main(argv=None) -> None:
+    from dexiraft_tpu.parallel.distributed import initialize
+
+    initialize()  # no-op single-process; multi-host via env vars
     args = build_parser().parse_args(argv)
     cfg, tc = resolve_configs(args)
     train(cfg, tc, args)

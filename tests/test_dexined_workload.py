@@ -130,8 +130,15 @@ def test_cli_train_then_test(biped_tree, tmp_path, monkeypatch):
     cv2.imwrite(str(classic / "t.jpg"),
                 np.random.default_rng(2).integers(
                     0, 256, (64, 64, 3), dtype=np.uint8))
+    # GT tree for the ODS/OIS/AP path (random GT -> just exercise wiring)
+    gt_dir = tmp_path / "gt"
+    gt_dir.mkdir()
+    cv2.imwrite(str(gt_dir / "t.png"),
+                (np.random.default_rng(3).random((64, 64)) < 0.05
+                 ).astype(np.uint8) * 255)
     out = str(tmp_path / "res")
     main(["--test", "--data_root", str(classic), "--dataset", "CLASSIC",
-          "--checkpoint", ckpt, "--output_dir", out])
+          "--checkpoint", ckpt, "--output_dir", out,
+          "--gt_root", str(gt_dir)])
     import os
     assert os.path.exists(os.path.join(out, "CLASSIC", "t.png"))

@@ -1,0 +1,32 @@
+"""Multi-host initialize(): env-driven modes and error paths."""
+
+import pytest
+
+from dexiraft_tpu.parallel.distributed import initialize
+
+
+def test_noop_without_env(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_AUTO_DISTRIBUTED", raising=False)
+    initialize()  # must not raise or touch jax.distributed
+
+
+def test_coordinator_without_nproc_raises(monkeypatch):
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES"):
+        initialize(coordinator_address="10.0.0.1:1234")
+
+
+def test_explicit_args_call_jax(monkeypatch):
+    calls = {}
+
+    def fake_init(**kw):
+        calls.update(kw)
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    initialize(coordinator_address="10.0.0.1:1234",
+               num_processes=4, process_id=2)
+    assert calls == {"coordinator_address": "10.0.0.1:1234",
+                     "num_processes": 4, "process_id": 2}

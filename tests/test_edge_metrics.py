@@ -1,0 +1,46 @@
+"""ODS/OIS/AP edge metrics."""
+
+import numpy as np
+
+from dexiraft_tpu.dexined.metrics import edge_counts, evaluate_edges
+
+
+def _gt_line(h=64, w=64, row=32):
+    gt = np.zeros((h, w), np.float32)
+    gt[row] = 1.0
+    return gt
+
+
+class TestEdgeMetrics:
+    def test_perfect_prediction(self):
+        gt = _gt_line()
+        res = evaluate_edges([gt.copy()], [gt])
+        assert res["ODS"] > 0.99 and res["OIS"] > 0.99
+        assert res["AP"] > 0.5  # PR curve is (1, 1) at all thresholds
+
+    def test_shifted_within_tolerance_still_matches(self):
+        gt = _gt_line(row=32)
+        pred = _gt_line(row=33)  # 1 px off, diag tolerance ~1 px at 64x64
+        res = evaluate_edges([pred], [gt])
+        assert res["ODS"] > 0.99
+
+    def test_garbage_prediction_scores_low(self):
+        gt = _gt_line()
+        rng = np.random.default_rng(0)
+        pred = (rng.random(gt.shape) < 0.02).astype(np.float32)
+        res = evaluate_edges([pred], [gt])
+        assert res["ODS"] < 0.5
+
+    def test_threshold_sweep_monotone_counts(self):
+        gt = _gt_line()
+        pred = np.linspace(0, 1, 64 * 64, dtype=np.float32).reshape(64, 64)
+        counts = edge_counts(pred, gt)
+        n_pred = counts[:, 1]
+        assert (np.diff(n_pred) <= 0).all()  # higher threshold, fewer preds
+
+    def test_ois_at_least_ods(self):
+        rng = np.random.default_rng(1)
+        gts = [_gt_line(row=r) for r in (16, 40)]
+        preds = [np.clip(g + 0.3 * rng.random(g.shape), 0, 1) for g in gts]
+        res = evaluate_edges(preds, gts)
+        assert res["OIS"] >= res["ODS"] - 1e-9
