@@ -1,0 +1,108 @@
+"""On-hardware smoke: run after the TPU tunnel recovers (cannot run under
+the CPU-pinned test suite).
+
+  python scripts/tpu_smoke.py            # all stages
+  python scripts/tpu_smoke.py pallas     # just the kernel parity
+
+Stages:
+  pallas   compile + parity of the Pallas local-corr kernel vs the XLA
+           gather path on the real chip (the interpret-mode tests cover
+           numerics; this covers Mosaic compilation)
+  train    one jitted v1-small train step on synthetic data
+  forward  flagship v5 test-mode forward at 440x1024 (bench shape)
+"""
+
+from __future__ import annotations
+
+import os.path as osp
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# repo root on sys.path: bench.py lives there (outside the package) and
+# `python scripts/tpu_smoke.py` only adds scripts/ itself
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+
+def stage_pallas() -> None:
+    from dexiraft_tpu.ops.local_corr import local_corr_level
+    from dexiraft_tpu.ops.pallas_corr import pallas_local_corr_level
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    b, h, w, c = 1, 55, 128, 256  # Sintel eval shape at 1/8
+    f1 = jax.random.normal(k1, (b, h, w, c), jnp.float32)
+    f2 = jax.random.normal(k2, (b, h, w, c), jnp.float32)
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    coords = (jnp.stack([xs, ys], -1)[None]
+              + jax.random.uniform(k3, (b, h, w, 2), jnp.float32, -3, 3))
+
+    t0 = time.perf_counter()
+    out_pallas = jax.block_until_ready(
+        jax.jit(lambda a, b_, c_: pallas_local_corr_level(a, b_, c_, 4))(
+            f1, f2, coords))
+    print(f"pallas compile+run: {time.perf_counter() - t0:.1f}s")
+    ref = jax.block_until_ready(
+        jax.jit(lambda a, b_, c_: local_corr_level(a, b_, c_, 4, row_chunk=8))(
+            f1, f2, coords))
+    np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    reps = 10
+    t0 = time.perf_counter()
+    fn = jax.jit(lambda a, b_, c_: pallas_local_corr_level(a, b_, c_, 4))
+    for _ in range(reps):
+        jax.block_until_ready(fn(f1, f2, coords))
+    dt_p = (time.perf_counter() - t0) / reps
+    fn2 = jax.jit(lambda a, b_, c_: local_corr_level(a, b_, c_, 4, row_chunk=8))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn2(f1, f2, coords))
+    dt_x = (time.perf_counter() - t0) / reps
+    print(f"PALLAS PARITY OK  pallas {dt_p * 1e3:.2f} ms vs "
+          f"xla-gather {dt_x * 1e3:.2f} ms per level-0 lookup")
+
+
+def stage_train() -> None:
+    from dexiraft_tpu.config import TrainConfig, raft_v1
+    from dexiraft_tpu.train.state import create_state
+    from dexiraft_tpu.train.step import make_train_step
+
+    cfg = raft_v1(small=True, mixed_precision=True)
+    tc = TrainConfig(num_steps=10, batch_size=2, image_size=(64, 64), iters=4)
+    state = create_state(jax.random.PRNGKey(0), cfg, tc)
+    step = make_train_step(cfg, tc)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": rng.uniform(0, 255, (2, 64, 64, 3)).astype(np.float32),
+        "image2": rng.uniform(0, 255, (2, 64, 64, 3)).astype(np.float32),
+        "flow": rng.normal(0, 1, (2, 64, 64, 2)).astype(np.float32),
+        "valid": np.ones((2, 64, 64), np.float32),
+    }
+    t0 = time.perf_counter()
+    state, metrics = step(state, batch)
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss)
+    print(f"TRAIN STEP OK loss={loss:.3f} "
+          f"(compile+run {time.perf_counter() - t0:.1f}s)")
+
+
+def stage_forward() -> None:
+    import bench
+
+    bench.main()
+
+
+STAGES = {"pallas": stage_pallas, "train": stage_train,
+          "forward": stage_forward}
+
+
+if __name__ == "__main__":
+    wanted = sys.argv[1:] or list(STAGES)
+    print(f"devices: {jax.devices()}")
+    for name in wanted:
+        print(f"--- {name} ---")
+        STAGES[name]()

@@ -35,6 +35,41 @@ class TestRemat:
                                    rtol=1e-4, atol=1e-5)
 
 
+class TestFreezeBN:
+    def test_freeze_bn_stops_stat_updates(self):
+        """freeze_bn=True (post-chairs stages, train.py:149-150) must run
+        BN on running stats and leave them untouched."""
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.config import raft_v1
+        from dexiraft_tpu.models.raft import RAFT
+
+        cfg = raft_v1()  # full model: cnet uses batch norm
+        model = RAFT(cfg)
+        img = jax.random.uniform(jax.random.PRNGKey(0), (1, 64, 64, 3),
+                                 jnp.float32, 0, 255)
+        variables = model.init(jax.random.PRNGKey(1), img, img,
+                               iters=1, train=False)
+        stats0 = variables["batch_stats"]
+
+        def run(freeze):
+            _, mut = model.apply(
+                variables, img, img, iters=1, train=True, freeze_bn=freeze,
+                mutable=["batch_stats"])
+            return mut["batch_stats"]
+
+        frozen = run(True)
+        for a, b in zip(jax.tree.leaves(stats0), jax.tree.leaves(frozen)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        live = run(False)
+        changed = any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(stats0), jax.tree.leaves(live)))
+        assert changed, "train-mode BN must update running stats"
+
+
 @pytest.fixture()
 def chairs_with_edges(tmp_path, monkeypatch):
     import imageio.v2 as imageio
