@@ -70,15 +70,20 @@ def main() -> None:
 
         @jax.jit
         def forward(a, b):
-            return model.apply(variables, a, b, iters=ITERS,
-                               train=False, test_mode=True)
+            low, up = model.apply(variables, a, b, iters=ITERS,
+                                  train=False, test_mode=True)
+            # reduce to one scalar so the timing loop can force a host
+            # round-trip: block_until_ready over the relay tunnel does not
+            # reliably block, so fetching this value is the only sync
+            # point that provably postdates the whole forward
+            return jnp.sum(low) + jnp.sum(up)
 
-        jax.block_until_ready(forward(image1, image2))  # compile + warmup
+        float(forward(image1, image2))  # compile + warmup
         _log(f"[{corr_impl}] compile+warmup done")
         reps = 5
         t0 = time.perf_counter()
         for _ in range(reps):
-            jax.block_until_ready(forward(image1, image2))
+            float(forward(image1, image2))
         dt = (time.perf_counter() - t0) / reps
         _log(f"[{corr_impl}] steady-state {dt * 1e3:.1f} ms / forward")
         return ITERS / dt

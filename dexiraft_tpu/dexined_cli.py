@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gt_root", default=None,
                    help="ground-truth edge-map dir: --test additionally "
                         "reports ODS/OIS/AP (dexined.metrics)")
+    p.add_argument("--test_pich", action="store_true",
+                   help="channel-swap ensemble test (reference testPich, "
+                        "main.py:149-187): second forward on the BGR-swapped "
+                        "image, merged where it is more edge-confident")
     return p
 
 
@@ -60,6 +64,52 @@ def save_edge_maps(fused_probs: np.ndarray, names, shapes, out_dir: str) -> None
         img = (255.0 * (1.0 - prob[..., 0])).clip(0, 255).astype(np.uint8)
         img = cv2.resize(img, (int(shape[1]), int(shape[0])))
         cv2.imwrite(osp.join(out_dir, osp.splitext(name)[0] + ".png"), img)
+
+
+def _normalize_invert(prob: np.ndarray) -> np.ndarray:
+    """min-max normalize to [0,255] then invert (utils/image.py:9-26,90-91)."""
+    lo, hi = float(prob.min()), float(prob.max())
+    img = (prob - lo) * 255.0 / (hi - lo + 1e-12)
+    return 255 - img.astype(np.uint8)
+
+
+def save_test_outputs(probs: np.ndarray, probs2, names, shapes,
+                      out_dir: str) -> None:
+    """The reference's full test-mode save protocol (utils/image.py:29-133).
+
+    probs: (7, B, H, W, 1) sigmoid outputs.  Each of the 7 maps is min-max
+    normalized, inverted, and resized to the source resolution; `fused/` gets
+    scale 7 (the block_cat output), `avg/` the mean over all 7.  With a
+    channel-swap second pass (probs2, testPich) the directories are named
+    `fusedCH`/`avgCH` and each map is merged with its swapped twin where the
+    twin is more edge-confident (pixels where map>128 but twin<128 take the
+    twin — utils/image.py:106-121).
+    """
+    import cv2
+
+    fuse_name, av_name = ("fusedCH", "avgCH") if probs2 is not None \
+        else ("fused", "avg")
+    dir_f = osp.join(out_dir, fuse_name)
+    dir_a = osp.join(out_dir, av_name)
+    os.makedirs(dir_f, exist_ok=True)
+    os.makedirs(dir_a, exist_ok=True)
+    for b, (name, shape) in enumerate(zip(names, shapes)):
+        size = (int(shape[1]), int(shape[0]))
+        preds, fuse = [], None
+        for s in range(probs.shape[0]):
+            img = cv2.resize(_normalize_invert(probs[s, b, ..., 0]), size)
+            if probs2 is not None:
+                img2 = cv2.resize(
+                    _normalize_invert(probs2[s, b, ..., 0]), size)
+                img = np.where((img > 128) & (img2 < 128), img2, img)
+            preds.append(img)
+            if s == probs.shape[0] - 1:
+                fuse = img.astype(np.uint8)
+        average = np.mean(np.asarray(preds, np.float32), axis=0).astype(
+            np.uint8)
+        stem = osp.splitext(name)[0] + ".png"
+        cv2.imwrite(osp.join(dir_f, stem), fuse)
+        cv2.imwrite(osp.join(dir_a, stem), average)
 
 
 def train(args) -> None:
@@ -153,17 +203,26 @@ def test(args) -> None:
     @jax.jit
     def forward(images):
         preds = model.apply(variables, images, train=False)
-        return jax.nn.sigmoid(preds[-1])  # fused map
+        return jnp.stack([jax.nn.sigmoid(p) for p in preds])  # (7,B,H,W,1)
 
     total, times = 0, []
     counts, gt_missing = [], []
     for i in range(len(dataset)):
         s = dataset.sample(i)
         t0 = time.perf_counter()
-        fused = np.asarray(jax.block_until_ready(
+        probs = np.asarray(jax.block_until_ready(
             forward(s["images"][None])))
+        probs2 = None
+        if args.test_pich:
+            # second forward on the channel-swapped image (main.py:172-174)
+            probs2 = np.asarray(jax.block_until_ready(
+                forward(s["images"][None][..., ::-1])))
         dt = time.perf_counter() - t0
         times.append(dt)
+        fused = probs[-1]
+        save_test_outputs(probs, probs2, [s["file_name"]],
+                          [s["image_shape"]],
+                          osp.join(args.output_dir, args.dataset))
         save_edge_maps(fused, [s["file_name"]], [s["image_shape"]],
                        osp.join(args.output_dir, args.dataset))
         if args.gt_root:

@@ -46,64 +46,87 @@ def _normalize(img: jax.Array) -> jax.Array:
 
 
 class RAFTStep(nn.Module):
-    """One refinement iteration; scanned with params broadcast."""
+    """One refinement iteration; scanned with params broadcast.
+
+    Both streams of the dual/separate variants ride ONE batch: the edge
+    stream is concatenated on the batch axis (the reference's two
+    update-block calls share a single update_block, core/raft.py:179-180,
+    so one call on batch 2B is the same math in half the dispatches — and
+    every correlation-lookup matmul runs at double batch instead of twice).
+
+    ``emit`` selects the scan output: per-iteration upsampled flows for
+    training (sequence_loss consumes all of them, train.py:48-73), nothing
+    in test mode — the final flow is upsampled ONCE after the scan from
+    the carried mask (test_mode returns only the last prediction,
+    core/raft.py:194-197).
+    """
 
     cfg: RAFTConfig
     dtype: Any = jnp.float32
+    emit: bool = True
 
     @nn.compact
-    def __call__(self, carry: Dict[str, Any], _):
+    def __call__(self, carry: Dict[str, Any], _, consts: Dict[str, Any]):
         cfg = self.cfg
         if cfg.small:
             update_block = SmallUpdateBlock(hidden_dim=cfg.hidden_dim, dtype=self.dtype)
         else:
             update_block = BasicUpdateBlock(hidden_dim=cfg.hidden_dim, dtype=self.dtype)
 
-        pyr = carry["pyr"]
-        coords0 = coords_grid(pyr.batch, pyr.ht, pyr.wd)
+        pyr = consts["pyr"]
+        dual = cfg.has_edge_stream
+        b = pyr.batch // 2 if dual else pyr.batch
+        coords0 = coords_grid(b, pyr.ht, pyr.wd)
 
-        coords1 = jax.lax.stop_gradient(carry["coords1"])
+        coords1 = jax.lax.stop_gradient(carry["coords1"])  # (2B or B, h, w, 2)
         corr = pyr(coords1)
-        flow = coords1 - coords0
-        net, up_mask, delta_flow = update_block(carry["net"], carry["inp"], corr, flow)
-        delta_flow = delta_flow.astype(jnp.float32)
+        flow = coords1 - jnp.concatenate([coords0, coords0], 0) if dual \
+            else coords1 - coords0
+        net, up_mask, delta = update_block(carry["net"], consts["inp"], corr, flow)
+        delta = delta.astype(jnp.float32)
 
-        if cfg.has_edge_stream:
-            ecoords1 = jax.lax.stop_gradient(carry["ecoords1"])
-            ecorr = carry["epyr"](ecoords1)
-            eflow = ecoords1 - coords0
-            enet, eup_mask, delta_eflow = update_block(
-                carry["enet"], carry["einp"], ecorr, eflow
-            )
-            delta_eflow = delta_eflow.astype(jnp.float32)
-
+        if dual:
+            delta_flow, delta_eflow = delta[:b], delta[b:]
+            ic, ec = coords1[:b], coords1[b:]
             if cfg.variant == "dual":
                 # coupled update: edge deltas injected into the image flow
                 # (core/raft.py:183-184)
-                coords1 = coords1 + delta_flow + delta_eflow
-                ecoords1 = ecoords1 + delta_eflow
+                ic = ic + delta_flow + delta_eflow
+                ec = ec + delta_eflow
             else:  # 'separate' (v3): decoupled (core/raft_3.py:160-161)
-                coords1 = coords1 + delta_flow
-                ecoords1 = ecoords1 + delta_eflow
-            carry = {**carry, "ecoords1": ecoords1, "enet": enet}
+                ic = ic + delta_flow
+                ec = ec + delta_eflow
+            coords1 = jnp.concatenate([ic, ec], 0)
         else:
-            coords1 = coords1 + delta_flow
-
-        flow_up = self._upsample(coords1 - coords0, up_mask)
-
-        if cfg.variant == "separate":
-            eflow_up = self._upsample(ecoords1 - coords0, eup_mask)
-            prediction = RefineFlow(dtype=self.dtype)(flow_up, eflow_up).astype(jnp.float32)
-        else:
-            prediction = flow_up
+            coords1 = coords1 + delta
 
         carry = {**carry, "coords1": coords1, "net": net}
+
+        if not self.emit:
+            # test mode: keep only what the post-scan upsample needs
+            carry["up_mask"] = up_mask
+            return carry, None
+
+        prediction = self._predict(cfg, coords1, coords0, up_mask, b)
         return carry, prediction
 
-    def _upsample(self, flow: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
-        if mask is None:  # small model has no mask head (core/raft.py:187-190)
-            return upflow8(flow)
-        return upsample_flow_convex(flow.astype(jnp.float32), mask.astype(jnp.float32))
+    def _predict(self, cfg, coords1, coords0, up_mask, b):
+        if cfg.has_edge_stream:
+            flow_up = _upsample(coords1[:b] - coords0,
+                                None if up_mask is None else up_mask[:b])
+            if cfg.variant == "separate":
+                eflow_up = _upsample(coords1[b:] - coords0,
+                                     None if up_mask is None else up_mask[b:])
+                return RefineFlow(dtype=self.dtype)(
+                    flow_up, eflow_up).astype(jnp.float32)
+            return flow_up
+        return _upsample(coords1 - coords0, up_mask)
+
+
+def _upsample(flow: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    if mask is None:  # small model has no mask head (core/raft.py:187-190)
+        return upflow8(flow)
+    return upsample_flow_convex(flow.astype(jnp.float32), mask.astype(jnp.float32))
 
 
 class RAFT(nn.Module):
@@ -148,10 +171,16 @@ class RAFT(nn.Module):
         em1 = em2 = None
         if cfg.embed_dexined:
             # frozen edge extraction: raw logits, gradients stopped — the
-            # no_grad contract of core/raft.py:111-123
-            dexined = DexiNed(dtype=jnp.float32)
-            em1 = jax.lax.stop_gradient(stack_edge_maps(dexined(image1, train=False)))
-            em2 = jax.lax.stop_gradient(stack_edge_maps(dexined(image2, train=False)))
+            # no_grad contract of core/raft.py:111-123. Both frames go
+            # through ONE batched call (better MXU utilization than two
+            # passes), and under mixed_precision the frozen extractor runs
+            # in bf16 like the encoders — the reference keeps it fp32 only
+            # because it sits outside the autocast region (docs/parity.md)
+            dexined = DexiNed(dtype=dtype)
+            both = jnp.concatenate([image1, image2], axis=0)
+            maps = stack_edge_maps(dexined(both, train=False))
+            maps = jax.lax.stop_gradient(maps.astype(jnp.float32))
+            em1, em2 = jnp.split(maps, 2, axis=0)
         elif cfg.variant in ("early", "separate"):
             if edges1 is None or edges2 is None:
                 raise ValueError(
@@ -188,19 +217,16 @@ class RAFT(nn.Module):
         fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
                             train=train, bn_train=bn_train)
         fmap1, fmap2 = fmap1.astype(jnp.float32), fmap2.astype(jnp.float32)
-        pyr = build_pyr(fmap1, fmap2)
 
         ctx = cnet(image1.astype(dtype), train=train, bn_train=bn_train)
         net = jnp.tanh(ctx[..., :hdim])
         inp = nn.relu(ctx[..., hdim:])
 
-        b, h8, w8 = pyr.batch, pyr.ht, pyr.wd
+        b, h8, w8 = fmap1.shape[0], fmap1.shape[1], fmap1.shape[2]
         coords0 = coords_grid(b, h8, w8)
         coords1 = coords_grid(b, h8, w8)
         if flow_init is not None:
             coords1 = coords1 + flow_init
-
-        carry: Dict[str, Any] = {"coords1": coords1, "net": net, "inp": inp, "pyr": pyr}
 
         if cfg.has_edge_stream:
             if cfg.variant == "dual":
@@ -213,14 +239,31 @@ class RAFT(nn.Module):
             fem1, fem2 = efnet((em1.astype(dtype), em2.astype(dtype)),
                                train=train, bn_train=bn_train)
             fem1, fem2 = fem1.astype(jnp.float32), fem2.astype(jnp.float32)
-            epyr = build_pyr(fem1, fem2)
             ectx = ecnet(em1.astype(dtype), train=train, bn_train=bn_train)
-            carry.update(
-                ecoords1=coords_grid(b, h8, w8),
-                enet=jnp.tanh(ectx[..., :hdim]),
-                einp=nn.relu(ectx[..., hdim:]),
-                epyr=epyr,
-            )
+            # both streams share one batch axis: one pyramid build, one
+            # lookup and one update-block call per iteration (RAFTStep)
+            pyr = build_pyr(jnp.concatenate([fmap1, fem1], 0),
+                            jnp.concatenate([fmap2, fem2], 0))
+            coords1 = jnp.concatenate([coords1, coords_grid(b, h8, w8)], 0)
+            net = jnp.concatenate([net, jnp.tanh(ectx[..., :hdim])], 0)
+            inp = jnp.concatenate([inp, nn.relu(ectx[..., hdim:])], 0)
+        else:
+            pyr = build_pyr(fmap1, fmap2)
+
+        carry: Dict[str, Any] = {"coords1": coords1, "net": net}
+        consts = {"pyr": pyr, "inp": inp}
+
+        # per-iteration upsampled flows are only consumed by the sequence
+        # loss; in test mode (except v3, whose RefineFlow head must stay
+        # inside the scanned module for parameter-path stability) the scan
+        # emits nothing and the final flow is upsampled once afterwards
+        emit = (not test_mode) or cfg.variant == "separate"
+        if not emit:
+            if cfg.small:
+                carry["up_mask"] = None
+            else:
+                nb = 2 * b if cfg.has_edge_stream else b
+                carry["up_mask"] = jnp.zeros((nb, h8, w8, 64 * 9), dtype)
 
         step_cls = RAFTStep
         if cfg.remat:
@@ -231,14 +274,20 @@ class RAFT(nn.Module):
             step_cls,
             variable_broadcast="params",
             split_rngs={"params": False},
+            in_axes=(0, nn.broadcast),
             length=iters,
         )
         # pin the module name so parameter paths (and thus checkpoints and
         # interop name maps) are identical with and without remat
-        carry, predictions = scan(cfg=cfg, dtype=dtype,
-                                  name="ScanRAFTStep_0")(carry, None)
+        carry, predictions = scan(cfg=cfg, dtype=dtype, emit=emit,
+                                  name="ScanRAFTStep_0")(carry, None, consts)
 
         if test_mode:
-            flow_low = carry["coords1"] - coords0
-            return flow_low, predictions[-1]
+            flow_low = carry["coords1"][:b] - coords0
+            if emit:
+                return flow_low, predictions[-1]
+            flow_up = _upsample(
+                flow_low,
+                None if carry["up_mask"] is None else carry["up_mask"][:b])
+            return flow_low, flow_up
         return predictions

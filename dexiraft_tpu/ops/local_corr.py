@@ -6,28 +6,27 @@ lattice of fmap2 rows around floor(coords) and scatter-accumulates the 4
 bilinear corner weights into a (2r+1)^2 window. O(HW * (2r+2)^2) memory
 instead of the materialized volume's O((HW)^2) (SURVEY.md §2.2).
 
-TPU-native reformulation (gather, not scatter):
-  1. gather the (2r+2)^2 integer patch of fmap2 around floor(coords)
-     (XLA gather HLO — the embedding-lookup path, HBM-bandwidth bound);
-  2. one batched einsum against fmap1 for the integer-lattice dots;
-  3. blend the 4 corners on the VPU: window[j] = sum_c w_c * lattice[j + c]
-     — the exact transpose of the CUDA kernel's scatter.
+TPU-native reformulation — flash-attention-style, all MXU matmuls:
+per chunk of query rows, the partial all-pairs block
+vol = f1_chunk · f2ᵀ (ops.corr.all_pairs_correlation) is materialized,
+windowed with the separable one-hot interpolation matmuls of
+ops.corr.interp_window, and discarded. Transient memory is
+O(chunk · W · H2 · W2) per level (`row_chunk` bounds it; lax.map keeps
+chunks sequential), never the full volume, and there are zero gather
+HLOs — TPU gathers measured 16-30x slower than recomputing the dots on
+the MXU.
 
 Like the reference's AlternateCorrBlock (core/corr.py:63-91), the pyramid
-pools FMAP2 (not the correlation volume), so numerics differ slightly
-from the materialized path at levels > 0 — the same approximation the
-reference makes. Out-of-frame lattice points contribute zero, matching
-bilinear_sampler's zero padding.
+pools FMAP2 (not the correlation volume) — since build_corr_pyramid now
+exploits the same linearity, the two paths agree to reassociation noise.
+Out-of-frame lattice points contribute zero, matching bilinear_sampler's
+zero padding.
 
-Gradients flow to fmap1/fmap2 through the gather/einsum; coords get zero
+Gradients flow to fmap1/fmap2 through the matmuls; coords get zero
 gradient (stop_gradient), replicating the CUDA backward's never-written
 coords_grad (correlation_kernel.cu:307). The reference's Python wrapper
 has NO autograd at all (core/corr.py:86 calls the op directly) — ours is
 trainable, a strict capability superset.
-
-Row-chunking (lax.map over row blocks) bounds the transient patch buffer:
-full-frame Sintel eval would otherwise materialize
-HW * (2r+2)^2 * C * 4B ≈ 720 MB per level.
 """
 
 from __future__ import annotations
@@ -54,6 +53,13 @@ def local_corr_level(
     fmap2: (B, H2, W2, C) target features at this pyramid level
     coords: (B, H, W, 2) sample centers in LEVEL pixels (x, y)
     Returns (B, H, W, (2r+1)^2) float32.
+
+    Flash-attention-style formulation: per query-row chunk, the partial
+    all-pairs block vol = f1_chunk · f2ᵀ (MXU matmul) is materialized,
+    windowed via the separable one-hot interpolation matmuls of
+    ops.corr.corr_lookup, and discarded — O(chunk·H2·W2) transient memory,
+    never the full O((HW)²) volume, and zero gather HLOs (TPU gathers
+    measured ~16-30x slower than rebuilding the dots on the MXU).
     """
     b, h, w, c = fmap1.shape
     coords = jax.lax.stop_gradient(coords)
@@ -77,60 +83,15 @@ def local_corr_level(
 def _local_corr_dense(
     fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array, radius: int
 ) -> jax.Array:
-    b, h, w, c = fmap1.shape
-    h2, w2 = fmap2.shape[1:3]
-    r = radius
-    k = 2 * r + 2  # integer lattice extent (window + 1 for bilinear)
+    from dexiraft_tpu.ops.corr import all_pairs_correlation, interp_window
 
-    x = coords[..., 0].astype(jnp.float32)
-    y = coords[..., 1].astype(jnp.float32)
-    x0 = jnp.floor(x)
-    y0 = jnp.floor(y)
-    fx = (x - x0)[..., None, None]  # (B, H, W, 1, 1)
-    fy = (y - y0)[..., None, None]
-
-    offs = jnp.arange(-r, r + 2, dtype=jnp.int32)  # (k,)
-    xs = x0.astype(jnp.int32)[..., None] + offs  # (B, H, W, k)
-    ys = y0.astype(jnp.int32)[..., None] + offs
-
-    vx = (xs >= 0) & (xs < w2)
-    vy = (ys >= 0) & (ys < h2)
-    xs_c = jnp.clip(xs, 0, w2 - 1)
-    ys_c = jnp.clip(ys, 0, h2 - 1)
-
-    # (B, H, W, k, k) flat indices into fmap2's H2*W2 axis: [ky, kx]
-    lin = ys_c[..., :, None] * w2 + xs_c[..., None, :]
-    valid = (vy[..., :, None] & vx[..., None, :]).astype(jnp.float32)
-
-    f2 = fmap2.reshape(b, h2 * w2, c)
-    patches = jnp.take_along_axis(
-        f2[:, None, :, :],
-        lin.reshape(b, 1, h * w * k * k, 1),
-        axis=2,
-    ).reshape(b, h, w, k, k, c)
-
-    # integer-lattice dot products, fp32 accumulate (MXU)
-    lattice = jnp.einsum(
-        "bhwc,bhwijc->bhwij",
-        fmap1.astype(jnp.float32),
-        patches.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    lattice = lattice * valid / jnp.sqrt(jnp.float32(c))
-
-    # bilinear corner blend: out[j] = sum_{cy,cx} w * lattice[j+cy, j+cx]
-    win = 2 * r + 1
-    tl = lattice[..., 0:win, 0:win]
-    tr = lattice[..., 0:win, 1:win + 1]
-    bl = lattice[..., 1:win + 1, 0:win]
-    br = lattice[..., 1:win + 1, 1:win + 1]
-    out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
-           + fy * (1 - fx) * bl + fy * fx * br)
-    # lattice axes are (y-offset, x-offset); the reference channel order
-    # has the x offset on the SLOW axis (transposed window,
-    # core/corr.py:37-43 — see ops.corr._window_delta), so swap before
-    # flattening to stay bit-compatible with the allpairs path
-    return out.swapaxes(-2, -1).reshape(b, h, w, win * win)
+    b, h, w, _ = fmap1.shape
+    win = 2 * radius + 1
+    # partial all-pairs block for these queries (fp32 accumulate, MXU)
+    vol = all_pairs_correlation(fmap1, fmap2)  # (B*H*W, H2, W2, 1)
+    flat = coords.reshape(b * h * w, 2).astype(jnp.float32)
+    window = interp_window(vol[..., 0], flat, radius)
+    return window.reshape(b, h, w, win * win)
 
 
 @flax.struct.dataclass

@@ -1,0 +1,165 @@
+"""A/B the corr_lookup formulation on the real chip at Sintel eval shape.
+
+  matmul    one-hot separable matmul (current corr_lookup)
+  matmul16  same but the volume stored bf16 (halved HBM traffic)
+  slice     vmapped dynamic_slice (2r+2)^2 patch + corner blend (the
+            pallas index-prep in pure XLA)
+
+Each runs 32 chained 2-stream lookups inside one scan (carry-dependent so
+iterations cannot be collapsed), one scalar out = one tunnel round-trip.
+"""
+
+from __future__ import annotations
+
+import os.path as osp
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.ops.corr import CorrPyramid, build_corr_pyramid, corr_lookup
+from dexiraft_tpu.ops.grid import coords_grid
+
+H8, W8, C = 55, 128, 256
+ITERS = 32
+RADIUS = 4
+
+
+def slice_lookup(pyramid: CorrPyramid, coords: jax.Array) -> jax.Array:
+    r = pyramid.radius
+    b, h, w = pyramid.batch, pyramid.ht, pyramid.wd
+    win = 2 * r + 1
+    k = 2 * r + 2
+    pad = k
+    flat = coords.reshape(b * h * w, 2).astype(jnp.float32)
+    out = []
+    for i, corr in enumerate(pyramid.levels):
+        hl, wl = corr.shape[1], corr.shape[2]
+        c = flat / (2.0 ** i)
+        x = jnp.clip(c[:, 0], -(r + 1.0), wl - 1 + r + 1.0)
+        y = jnp.clip(c[:, 1], -(r + 1.0), hl - 1 + r + 1.0)
+        x0 = jnp.floor(x)
+        y0 = jnp.floor(y)
+        fx = (x - x0)[:, None, None]
+        fy = (y - y0)[:, None, None]
+        sx = x0.astype(jnp.int32) + (r + 2)
+        sy = y0.astype(jnp.int32) + (r + 2)
+        volp = jnp.pad(corr[..., 0], ((0, 0), (pad, pad), (pad, pad)))
+
+        patch = jax.vmap(
+            lambda v, py, px: jax.lax.dynamic_slice(v, (py, px), (k, k))
+        )(volp, sy, sx)  # (N, k, k)
+
+        tl = patch[:, 0:win, 0:win]
+        tr = patch[:, 0:win, 1:win + 1]
+        bl = patch[:, 1:win + 1, 0:win]
+        br = patch[:, 1:win + 1, 1:win + 1]
+        o = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
+             + fy * (1 - fx) * bl + fy * fx * br)
+        out.append(o.swapaxes(1, 2).reshape(b, h, w, win * win))
+    return jnp.concatenate(out, axis=-1)
+
+
+def bench(name, lookup, cast=lambda x: x):
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (1, H8, W8, C), jnp.float32)
+    f2 = jax.random.normal(jax.random.fold_in(key, 1), (1, H8, W8, C))
+
+    @jax.jit
+    def run(f1, f2):
+        pyr = build_corr_pyramid(f1, f2, 4, RADIUS)
+        pyr2 = build_corr_pyramid(f2, f1, 4, RADIUS)
+        pyr = pyr.replace(levels=tuple(cast(l) for l in pyr.levels))
+        pyr2 = pyr2.replace(levels=tuple(cast(l) for l in pyr2.levels))
+        coords = coords_grid(1, H8, W8)
+
+        def body(co, _):
+            s = lookup(pyr, co) + lookup(pyr2, co)
+            co = co + 0.01 * s.mean(axis=-1, keepdims=True)
+            return co, None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return jnp.sum(co)
+
+    float(run(f1, f2))
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        float(run(f1, f2))
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:>10s}: {dt * 1e3:8.1f} ms total, "
+          f"{dt / ITERS * 1e3:6.2f} ms/iter")
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform}", file=sys.stderr)
+    t = jax.jit(lambda x: jnp.sum(x))
+    float(t(jnp.ones((8, 8))))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(t(jnp.ones((8, 8))))
+    print(f"       rtt: {(time.perf_counter() - t0) / 3 * 1e3:8.1f} ms")
+
+    bench("matmul", corr_lookup)
+    bench("matmul16", corr_lookup,
+          cast=lambda l: l.astype(jnp.bfloat16))
+    bench_batched("batched", jnp.float32)
+    bench_batched("batched16", jnp.bfloat16)
+
+
+def bench_batched(name, adt):
+    """Both streams' lookups through ONE set of einsums: pyramids built
+    from batch-2 fmaps (N doubles, matmul count halves); optionally the
+    whole lookup in bf16 (one-hot A and volume) with fp32 accumulate."""
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (2, H8, W8, C), jnp.float32)
+    f2 = jax.random.normal(jax.random.fold_in(key, 1), (2, H8, W8, C))
+
+    from dexiraft_tpu.ops.corr import _axis_interp_matrix
+
+    def lookup(pyr, coords):
+        r, b, h, w = pyr.radius, pyr.batch, pyr.ht, pyr.wd
+        win = 2 * r + 1
+        flat = coords.reshape(b * h * w, 2).astype(jnp.float32)
+        out = []
+        for i, corr in enumerate(pyr.levels):
+            hl, wl = corr.shape[1], corr.shape[2]
+            center = flat / (2.0 ** i)
+            ax = _axis_interp_matrix(center[:, 0], r, wl).astype(adt)
+            ay = _axis_interp_matrix(center[:, 1], r, hl).astype(adt)
+            vol = corr[..., 0].astype(adt)
+            rows = jnp.einsum("nby,nyx->nbx", ay, vol,
+                              preferred_element_type=jnp.float32).astype(adt)
+            window = jnp.einsum("nax,nbx->nab", ax, rows,
+                                preferred_element_type=jnp.float32)
+            out.append(window.reshape(b, h, w, win * win))
+        return jnp.concatenate(out, axis=-1).astype(jnp.float32)
+
+    @jax.jit
+    def run(f1, f2):
+        pyr = build_corr_pyramid(f1, f2, 4, RADIUS)  # batch-2 = 2 streams
+        coords = coords_grid(2, H8, W8)
+
+        def body(co, _):
+            s = lookup(pyr, co)
+            co = co + 0.01 * s.mean(axis=-1, keepdims=True)
+            return co, None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return jnp.sum(co)
+
+    float(run(f1, f2))
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        float(run(f1, f2))
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:>10s}: {dt * 1e3:8.1f} ms total, "
+          f"{dt / ITERS * 1e3:6.2f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

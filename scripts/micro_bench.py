@@ -1,0 +1,161 @@
+"""Component microbenchmark on the real chip — where does the forward go?
+
+Times, with the float-sync pattern (block_until_ready does not reliably
+block through the relay tunnel):
+  rtt        scalar fetch on a trivial jitted fn (the measurement floor)
+  prelude    DexiNed(x2) + 4 encoder passes at eval res
+  volume     all-pairs matmul + pyramid (x2 streams)
+  lookup32   32 chained corr_lookup calls (both streams, carry-dependent)
+  update32   32 chained update-block iterations without lookup
+  forward    the full v5 test-mode forward (sanity: ~ sum of the above)
+
+Run:  python scripts/micro_bench.py [--impl allpairs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os.path as osp
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+HEIGHT, WIDTH = 440, 1024
+ITERS = 32
+
+
+_RTT = [0.0]
+
+
+def timeit(name, fn, *args, reps=3):
+    """fn must return a pytree; it is reduced to ONE device scalar inside
+    jit so the sync fetch costs exactly one tunnel round-trip."""
+    reduced = jax.jit(
+        lambda *a: jax.tree_util.tree_reduce(
+            lambda acc, x: acc + jnp.sum(x).astype(jnp.float32),
+            fn(*a), jnp.float32(0)))
+    float(reduced(*args))  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        float(reduced(*args))
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:>10s}: {dt * 1e3:8.1f} ms   (-rtt {max(dt - _RTT[0], 0) * 1e3:8.1f} ms)")
+    return dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--impl", default="allpairs")
+    args = ap.parse_args()
+
+    from dexiraft_tpu.config import raft_v5
+    from dexiraft_tpu.models.raft import RAFT
+    from dexiraft_tpu.ops.corr import build_corr_pyramid, corr_lookup
+    from dexiraft_tpu.ops.grid import coords_grid
+
+    print(f"platform={jax.devices()[0].platform}", file=sys.stderr)
+
+    # --- RTT floor ---
+    _RTT[0] = timeit("rtt", lambda x: x, jnp.ones((8, 8)))
+
+    h8, w8, c = HEIGHT // 8, WIDTH // 8, 256
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (1, h8, w8, c), jnp.float32)
+    f2 = jax.random.normal(jax.random.fold_in(key, 1), (1, h8, w8, c))
+
+    # --- volume build (both streams, all levels) ---
+    def volume(f1, f2):
+        p1 = build_corr_pyramid(f1, f2, 4, 4)
+        p2 = build_corr_pyramid(f2, f1, 4, 4)
+        return p1.levels + p2.levels
+
+    timeit("volume", volume, f1, f2)
+
+    # --- DexiNed + encoders at eval res ---
+    from dexiraft_tpu.models.dexined import DexiNed
+
+    dexi = DexiNed(dtype=jnp.float32)
+    dimg = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    dvars = jax.jit(lambda r, x: dexi.init(r, x, train=False))(
+        jax.random.PRNGKey(2), dimg)
+    big = jax.random.uniform(jax.random.PRNGKey(3),
+                             (1, HEIGHT, WIDTH, 3), jnp.float32, -1, 1)
+
+    def dexined2(a):
+        return (dexi.apply(dvars, a, train=False)[-1],
+                dexi.apply(dvars, -a, train=False)[-1])
+
+    timeit("dexined_x2", dexined2, big)
+
+    from dexiraft_tpu.models.extractor import Encoder
+
+    enc = Encoder(256, "instance", 0.0, jnp.bfloat16)
+    evars = jax.jit(lambda r, x: enc.init(r, x, train=False))(
+        jax.random.PRNGKey(4), jnp.zeros((1, 64, 64, 3), jnp.bfloat16))
+
+    def enc4(a):
+        x = a.astype(jnp.bfloat16)
+        return [enc.apply(evars, x, train=False) for _ in range(4)]
+
+    timeit("enc_x4", enc4, big)
+
+    # --- 32 chained lookups (2 streams) ---
+    @jax.jit
+    def lookup32(f1, f2):
+        pyr = build_corr_pyramid(f1, f2, 4, 4)
+        pyr2 = build_corr_pyramid(f2, f1, 4, 4)
+        coords = coords_grid(1, h8, w8)
+
+        def body(carry, _):
+            co = carry
+            s = corr_lookup(pyr, co)
+            s2 = corr_lookup(pyr2, co)
+            co = co + 0.01 * (s.mean(axis=-1, keepdims=True)
+                              + s2.mean(axis=-1, keepdims=True))
+            return co, None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return co
+
+    t_lookup = timeit("lookup32", lookup32, f1, f2)
+
+    # --- full forward ---
+    from dexiraft_tpu.config import raft_v5
+
+    cfg = raft_v5(mixed_precision=True, corr_impl=args.impl)
+    model = RAFT(cfg)
+    img = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    init = jax.jit(lambda r, a, b: model.init(r, a, b, iters=1, train=False))
+    variables = init(jax.random.PRNGKey(0), img, img)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    im1 = jax.random.uniform(k1, (1, HEIGHT, WIDTH, 3), jnp.float32, 0, 255)
+    im2 = jax.random.uniform(k2, (1, HEIGHT, WIDTH, 3), jnp.float32, 0, 255)
+
+    @jax.jit
+    def fwd(a, b):
+        low, up = model.apply(variables, a, b, iters=ITERS, train=False,
+                              test_mode=True)
+        return jnp.sum(low) + jnp.sum(up)
+
+    t_fwd = timeit("forward", fwd, im1, im2)
+
+    # --- prelude: everything before the loop (iters=1 minus 1 lookup) ---
+    @jax.jit
+    def fwd1(a, b):
+        low, up = model.apply(variables, a, b, iters=1, train=False,
+                              test_mode=True)
+        return jnp.sum(low) + jnp.sum(up)
+
+    t_one = timeit("fwd_iter1", fwd1, im1, im2)
+    per_iter = (t_fwd - t_one) / (ITERS - 1)
+    print(f"  -> per-iteration cost {per_iter * 1e3:6.1f} ms; "
+          f"prelude+1 {t_one * 1e3:.1f} ms; "
+          f"lookup32/iter {t_lookup / ITERS * 1e3:6.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

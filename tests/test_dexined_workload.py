@@ -142,3 +142,33 @@ def test_cli_train_then_test(biped_tree, tmp_path, monkeypatch):
           "--gt_root", str(gt_dir)])
     import os
     assert os.path.exists(os.path.join(out, "CLASSIC", "t.png"))
+
+
+def test_cli_test_pich_channel_swap(biped_tree, tmp_path, monkeypatch):
+    """testPich parity (main.py:149-187): channel-swap ensemble writes
+    fusedCH/avgCH alongside the plain fused/avg protocol dirs."""
+    import os
+
+    import cv2
+
+    from dexiraft_tpu.dexined_cli import main
+
+    monkeypatch.chdir(tmp_path)
+    ckpt = str(tmp_path / "ck")
+    main(["--train", "--data_root", str(biped_tree), "--epochs", "1",
+          "--batch_size", "2", "--img_size", "64", "--lr", "1e-4",
+          "--steps_per_epoch", "1", "--checkpoint", ckpt])
+    classic = biped_tree / "classic2"
+    classic.mkdir(exist_ok=True)
+    cv2.imwrite(str(classic / "p.jpg"),
+                np.random.default_rng(4).integers(
+                    0, 256, (64, 64, 3), dtype=np.uint8))
+    out = str(tmp_path / "res2")
+    main(["--test", "--test_pich", "--data_root", str(classic),
+          "--dataset", "CLASSIC", "--checkpoint", ckpt,
+          "--output_dir", out])
+    for sub in ("fusedCH", "avgCH"):
+        path = os.path.join(out, "CLASSIC", sub, "p.png")
+        assert os.path.exists(path), path
+        img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
+        assert img.shape == (64, 64)

