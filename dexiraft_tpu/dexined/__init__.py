@@ -3,6 +3,7 @@ losses, BIPED-family datasets, and the train/test CLI driver."""
 
 from dexiraft_tpu.dexined.losses import (
     bdcn_loss2,
+    bdcn_loss_ori,
     cats_loss,
     hed_loss2,
     rcf_loss,
@@ -11,6 +12,7 @@ from dexiraft_tpu.dexined.losses import (
 
 __all__ = [
     "bdcn_loss2",
+    "bdcn_loss_ori",
     "hed_loss2",
     "rcf_loss",
     "cats_loss",

@@ -2,8 +2,9 @@
 
 All take NHWC logits (B, H, W, 1) and targets in [0, 1] (same layout) and
 return a scalar; class balancing statistics are computed over the whole
-batch tensor, matching the torch versions. The RCF convention reserves
-target==2 for don't-care pixels.
+batch tensor, matching the torch versions — except bdcn_loss_ori, which
+balances per sample like the reference's bdcn_lossORI. The RCF convention
+reserves target==2 for don't-care pixels.
 """
 
 from __future__ import annotations
@@ -50,6 +51,24 @@ def hed_loss2(logits: jax.Array, targets: jax.Array,
     num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
     total = num_pos + num_neg
     w = jnp.where(pos > 0, num_neg / total, 1.1 * num_pos / total)
+    return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
+
+
+def bdcn_loss_ori(logits: jax.Array, targets: jax.Array,
+                  l_weight: float = 1.1) -> jax.Array:
+    """Original BDCN loss (losses.py:37-58 ``bdcn_lossORI``): class
+    balancing PER SAMPLE instead of over the batch — for image i,
+    exactly-1 pixels weigh num_neg_i/valid_i, exactly-0 pixels
+    1.1*num_pos_i/valid_i, everything else weight 0 (the torch version's
+    weights array starts as zeros and only those two masks are filled)."""
+    t = targets.astype(jnp.float32)
+    pos = (t == 1.0).astype(jnp.float32)
+    neg = (t == 0.0).astype(jnp.float32)
+    axes = tuple(range(1, t.ndim))  # per-sample statistics
+    num_pos = jnp.sum(pos, axis=axes, keepdims=True)
+    num_neg = jnp.sum(neg, axis=axes, keepdims=True)
+    valid = jnp.maximum(num_pos + num_neg, 1.0)
+    w = pos * (num_neg / valid) + neg * (1.1 * num_pos / valid)
     return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
 
 

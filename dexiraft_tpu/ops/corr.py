@@ -122,20 +122,18 @@ def _window_delta(radius: int, dtype=jnp.float32) -> jax.Array:
 def _axis_interp_matrix(center: jax.Array, radius: int, size: int) -> jax.Array:
     """Per-pixel 1-D bilinear selection matrix A (N, 2r+1, size).
 
-    A[n, j, p] = w0[n]·[p == floor(c_n) + j - r] + w1[n]·[p == floor(c_n)
-    + j - r + 1] — row j interpolates the axis at coordinate c_n + (j - r).
-    Out-of-range taps simply find no matching p, reproducing the zero
-    padding of bilinear_sampler / F.grid_sample(zeros).
+    Row j interpolates the axis at coordinate t = c_n + (j - radius);
+    linear interpolation between floor(t) and floor(t)+1 is exactly the
+    triangular hat kernel, so A[n, j, p] = relu(1 - |p - t|) — one fused
+    elementwise expression, and out-of-range taps have empty support,
+    reproducing the zero padding of bilinear_sampler /
+    F.grid_sample(zeros). d/dc matches grid_sample's coordinate gradient
+    almost everywhere.
     """
-    c0 = jnp.floor(center)
-    w1 = (center - c0)[:, None, None]  # (N, 1, 1)
-    w0 = 1.0 - w1
-    base = c0.astype(jnp.int32)[:, None] + jnp.arange(
-        -radius, radius + 1, dtype=jnp.int32)  # (N, win)
-    pos = jnp.arange(size, dtype=jnp.int32)[None, None, :]  # (1, 1, size)
-    eq0 = (pos == base[..., None]).astype(jnp.float32)
-    eq1 = (pos == base[..., None] + 1).astype(jnp.float32)
-    return w0 * eq0 + w1 * eq1
+    t = center[:, None] + jnp.arange(-radius, radius + 1,
+                                     dtype=jnp.float32)  # (N, win)
+    pos = jnp.arange(size, dtype=jnp.float32)[None, None, :]  # (1, 1, size)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(pos - t[..., None]))
 
 
 def interp_window(vol: jax.Array, centers: jax.Array, radius: int) -> jax.Array:

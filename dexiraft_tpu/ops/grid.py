@@ -75,18 +75,37 @@ def bilinear_sampler(img: jax.Array, coords: jax.Array) -> jax.Array:
     return out
 
 
+def _resize_matrix(n_in: int, n_out: int, dtype) -> jax.Array:
+    """Static 1-D align_corners interpolation matrix (n_out, n_in).
+
+    Output pixel o samples input coordinate o*(n_in-1)/(n_out-1); linear
+    interpolation is the triangular hat kernel relu(1 - |p - t|).
+    """
+    t = (jnp.linspace(0.0, n_in - 1.0, n_out, dtype=jnp.float32)
+         if n_out > 1 else jnp.zeros((1,), jnp.float32))
+    pos = jnp.arange(n_in, dtype=jnp.float32)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(pos[None, :] - t[:, None])).astype(dtype)
+
+
 def resize_bilinear_align_corners(img: jax.Array, ht: int, wd: int) -> jax.Array:
     """Bilinear resize with align_corners=True semantics (torch interpolate).
 
-    ``jax.image.resize`` uses half-pixel centers, so we sample explicitly:
-    output pixel i maps to input coordinate i * (in-1)/(out-1).
+    ``jax.image.resize`` uses half-pixel centers, so we interpolate
+    explicitly — and since the target grid is REGULAR, the resize is
+    separable into two dense matmuls against static hat matrices (MXU
+    work; per-pixel gather sampling is ~2 orders slower on TPU).
     """
-    n, h, w = img.shape[0], img.shape[1], img.shape[2]
-    ys = jnp.linspace(0.0, h - 1.0, ht, dtype=img.dtype) if ht > 1 else jnp.zeros((1,), img.dtype)
-    xs = jnp.linspace(0.0, w - 1.0, wd, dtype=img.dtype) if wd > 1 else jnp.zeros((1,), img.dtype)
-    xx, yy = jnp.meshgrid(xs, ys)
-    coords = jnp.broadcast_to(jnp.stack([xx, yy], axis=-1)[None], (n, ht, wd, 2))
-    return bilinear_sampler(img, coords)
+    h, w = img.shape[1], img.shape[2]
+    ry = _resize_matrix(h, ht, img.dtype)  # (ht, h)
+    rx = _resize_matrix(w, wd, img.dtype)  # (wd, w)
+    # HIGHEST precision: TPU matmul at DEFAULT truncates operands to
+    # bf16, which the elementwise sampler this replaces never did
+    out = jnp.einsum("oy,nyxc->noxc", ry, img,
+                     precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32).astype(img.dtype)
+    return jnp.einsum("px,noxc->nopc", rx, out,
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32).astype(img.dtype)
 
 
 def upflow8(flow: jax.Array) -> jax.Array:

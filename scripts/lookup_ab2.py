@@ -1,0 +1,174 @@
+"""Second-round lookup experiments: where do the 2.9 ms/iter go?
+
+Variants (all 2 streams batched, N = 14080, 4 levels, 32 chained iters):
+  current     interp_window as shipped (y-contraction first)
+  xfirst      contract x first (K = lane-major 128) then y
+  fused       single three-operand einsum (XLA picks the path)
+  build_only  just construct the one-hot A matrices each iteration
+  mm_only     pre-built A matrices outside the loop, only the matmuls
+"""
+
+from __future__ import annotations
+
+import os.path as osp
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.ops.corr import (
+    _axis_interp_matrix,
+    build_corr_pyramid,
+    corr_lookup,
+)
+from dexiraft_tpu.ops.grid import coords_grid
+
+H8, W8, C = 55, 128, 256
+ITERS = 32
+R = 4
+WIN = 2 * R + 1
+
+
+def _pyr():
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (2, H8, W8, C), jnp.float32)
+    f2 = jax.random.normal(jax.random.fold_in(key, 1), (2, H8, W8, C))
+    return f1, f2
+
+
+def _time(name, run, *args):
+    float(run(*args))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(run(*args))
+    dt = (time.perf_counter() - t0) / 3
+    print(f"{name:>10s}: {dt * 1e3:8.1f} ms total, {dt / ITERS * 1e3:6.2f} ms/iter")
+
+
+def bench_lookup(name, level_fn):
+    f1, f2 = _pyr()
+
+    @jax.jit
+    def run(f1, f2):
+        pyr = build_corr_pyramid(f1, f2, 4, R)
+        coords = coords_grid(2, H8, W8)
+
+        def body(co, _):
+            flat = co.reshape(-1, 2)
+            out = []
+            for i, corr in enumerate(pyr.levels):
+                out.append(level_fn(corr[..., 0], flat / (2.0 ** i)))
+            s = jnp.concatenate(out, axis=-1).reshape(2, H8, W8, -1)
+            return co + 0.01 * s.mean(axis=-1, keepdims=True), None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return jnp.sum(co)
+
+    _time(name, run, f1, f2)
+
+
+def lvl_current(vol, centers):
+    ay = _axis_interp_matrix(centers[:, 1], R, vol.shape[1])
+    ax = _axis_interp_matrix(centers[:, 0], R, vol.shape[2])
+    rows = jnp.einsum("nby,nyx->nbx", ay, vol,
+                      preferred_element_type=jnp.float32)
+    return jnp.einsum("nax,nbx->nab", ax, rows,
+                      preferred_element_type=jnp.float32).reshape(
+        vol.shape[0], WIN * WIN)
+
+
+def lvl_xfirst(vol, centers):
+    ay = _axis_interp_matrix(centers[:, 1], R, vol.shape[1])
+    ax = _axis_interp_matrix(centers[:, 0], R, vol.shape[2])
+    cols = jnp.einsum("nax,nyx->nay", ax, vol,
+                      preferred_element_type=jnp.float32)
+    return jnp.einsum("nby,nay->nab", ay, cols,
+                      preferred_element_type=jnp.float32).reshape(
+        vol.shape[0], WIN * WIN)
+
+
+def lvl_fused(vol, centers):
+    ay = _axis_interp_matrix(centers[:, 1], R, vol.shape[1])
+    ax = _axis_interp_matrix(centers[:, 0], R, vol.shape[2])
+    return jnp.einsum("nby,nyx,nax->nab", ay, vol, ax,
+                      preferred_element_type=jnp.float32).reshape(
+        vol.shape[0], WIN * WIN)
+
+
+def bench_build_only():
+    f1, f2 = _pyr()
+
+    @jax.jit
+    def run(f1, f2):
+        coords = coords_grid(2, H8, W8)
+        sizes = [(H8, W8), (27, 64), (13, 32), (6, 16)]
+
+        def body(co, _):
+            flat = co.reshape(-1, 2)
+            acc = 0.0
+            for i, (hl, wl) in enumerate(sizes):
+                c = flat / (2.0 ** i)
+                ay = _axis_interp_matrix(c[:, 1], R, hl)
+                ax = _axis_interp_matrix(c[:, 0], R, wl)
+                acc = acc + ay.sum() + ax.sum()
+            return co + 1e-9 * acc, None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return jnp.sum(co)
+
+    _time("build_only", run, f1, f2)
+
+
+def bench_mm_only():
+    f1, f2 = _pyr()
+
+    @jax.jit
+    def run(f1, f2):
+        pyr = build_corr_pyramid(f1, f2, 4, R)
+        coords = coords_grid(2, H8, W8)
+        flat = coords.reshape(-1, 2)
+        mats = []
+        for i, corr in enumerate(pyr.levels):
+            c = flat / (2.0 ** i)
+            mats.append((_axis_interp_matrix(c[:, 1], R, corr.shape[1]),
+                         _axis_interp_matrix(c[:, 0], R, corr.shape[2])))
+
+        def body(carry, _):
+            acc = carry
+            outs = []
+            for (ay, ax), corr in zip(mats, pyr.levels):
+                vol = corr[..., 0] + acc  # keep iteration-dependent
+                rows = jnp.einsum("nby,nyx->nbx", ay, vol,
+                                  preferred_element_type=jnp.float32)
+                w = jnp.einsum("nax,nbx->nab", ax, rows,
+                               preferred_element_type=jnp.float32)
+                outs.append(w.sum())
+            return acc + 1e-9 * sum(outs), None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=ITERS)
+        return acc
+
+    _time("mm_only", run, f1, f2)
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform}", file=sys.stderr)
+    t = jax.jit(lambda x: jnp.sum(x))
+    float(t(jnp.ones((8, 8))))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(t(jnp.ones((8, 8))))
+    print(f"       rtt: {(time.perf_counter() - t0) / 3 * 1e3:8.1f} ms")
+
+    bench_lookup("current", lvl_current)
+    bench_lookup("xfirst", lvl_xfirst)
+    bench_lookup("fused", lvl_fused)
+    bench_build_only()
+    bench_mm_only()
+
+
+if __name__ == "__main__":
+    main()

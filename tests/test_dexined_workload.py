@@ -7,6 +7,7 @@ import pytest
 
 from dexiraft_tpu.dexined.losses import (
     bdcn_loss2,
+    bdcn_loss_ori,
     cats_loss,
     hed_loss2,
     rcf_loss,
@@ -37,6 +38,26 @@ class TestLosses:
         miss = base.at[0, 4, 4, 0].set(-4.0)  # confident wrong on the edge
         fp = base.at[0, 2, 2, 0].set(4.0)     # confident wrong on background
         assert float(bdcn_loss2(miss, targets)) > float(bdcn_loss2(fp, targets))
+
+    def test_bdcn_ori_per_sample_balance(self):
+        """bdcn_lossORI (losses.py:37-58) balances per sample: a batch of
+        one dense-edge and one sparse-edge image must weigh them
+        differently, so the loss differs from pooled-batch balancing on
+        the same data; fractional targets get zero weight."""
+        key = jax.random.PRNGKey(3)
+        k1, k2, k3 = jax.random.split(key, 3)
+        logits = jax.random.normal(k1, (2, 16, 16, 1))
+        dense = (jax.random.uniform(k2, (1, 16, 16, 1)) < 0.5)
+        sparse = (jax.random.uniform(k3, (1, 16, 16, 1)) < 0.05)
+        targets = jnp.concatenate([dense, sparse]).astype(jnp.float32)
+        loss = bdcn_loss_ori(logits, targets)
+        assert float(loss) > 0.0 and np.isfinite(float(loss))
+        g = jax.grad(lambda l: bdcn_loss_ori(l, targets))(logits)
+        assert np.isfinite(np.asarray(g)).all()
+        # fractional annotations carry zero weight (torch fills only the
+        # ==1 and ==0 masks of a zeros array)
+        frac = jnp.full((2, 16, 16, 1), 0.5)
+        assert float(bdcn_loss_ori(logits, frac)) == 0.0
 
     def test_hed_and_rcf_finite(self):
         logits, targets = _logits_targets(jax.random.PRNGKey(1))
