@@ -119,7 +119,8 @@ def _window_delta(radius: int, dtype=jnp.float32) -> jax.Array:
     return jnp.stack([di, dj], axis=-1)  # (x + di, y + dj)
 
 
-def _axis_interp_matrix(center: jax.Array, radius: int, size: int) -> jax.Array:
+def _axis_interp_matrix(center: jax.Array, radius: int, size: int,
+                        offset=0) -> jax.Array:
     """Per-pixel 1-D bilinear selection matrix A (N, 2r+1, size).
 
     Row j interpolates the axis at coordinate t = c_n + (j - radius);
@@ -129,10 +130,14 @@ def _axis_interp_matrix(center: jax.Array, radius: int, size: int) -> jax.Array:
     reproducing the zero padding of bilinear_sampler /
     F.grid_sample(zeros). d/dc matches grid_sample's coordinate gradient
     almost everywhere.
+
+    ``offset`` shifts the axis positions: column p represents global
+    coordinate offset + p (used by ring context parallelism, where each
+    chip holds a row BLOCK of the target axis).
     """
     t = center[:, None] + jnp.arange(-radius, radius + 1,
                                      dtype=jnp.float32)  # (N, win)
-    pos = jnp.arange(size, dtype=jnp.float32)[None, None, :]  # (1, 1, size)
+    pos = offset + jnp.arange(size, dtype=jnp.float32)[None, None, :]
     return jnp.maximum(0.0, 1.0 - jnp.abs(pos - t[..., None]))
 
 

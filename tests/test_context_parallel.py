@@ -9,7 +9,10 @@ import numpy as np
 from dexiraft_tpu.config import TrainConfig, raft_v1
 from dexiraft_tpu.ops.corr import build_corr_pyramid, corr_lookup
 from dexiraft_tpu.ops.grid import coords_grid
-from dexiraft_tpu.parallel.context import context_parallel_corr
+from dexiraft_tpu.parallel.context import (
+    context_parallel_corr,
+    ring_corr_lookup,
+)
 from dexiraft_tpu.parallel.mesh import (
     make_mesh,
     make_mesh_2d,
@@ -50,6 +53,40 @@ class TestContextParallelCorr:
         np.testing.assert_allclose(np.asarray(out),
                                    np.asarray(corr_lookup(pyr, coords)),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestRingCorrLookup:
+    def test_matches_unsharded(self):
+        """Ring-rotated target blocks (the ring-attention analog) must
+        reproduce the unsharded lookup exactly: hat-stencil supports
+        partition across blocks."""
+        f1, f2, coords = _fmaps(jax.random.PRNGKey(2))
+        mesh = make_mesh_2d(2, 4)  # H=16 over 4 ring chips -> blocks of 4
+        out = ring_corr_lookup(f1, f2, coords, mesh,
+                               num_levels=3, radius=3)
+        pyr = build_corr_pyramid(f1, f2, num_levels=3, radius=3)
+        ref = corr_lookup(pyr, coords)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_full_ring_under_jit(self):
+        f1, f2, coords = _fmaps(jax.random.PRNGKey(3), h=32)
+        mesh = make_mesh_2d(1, 8)  # blocks of 4 rows over an 8-ring
+        fn = jax.jit(lambda a, b, c: ring_corr_lookup(
+            a, b, c, mesh, num_levels=2, radius=4))
+        out = fn(f1, f2, coords)
+        pyr = build_corr_pyramid(f1, f2, num_levels=2, radius=4)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(corr_lookup(pyr, coords)),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_alignment_guard(self):
+        import pytest
+
+        f1, f2, coords = _fmaps(jax.random.PRNGKey(4), h=12)
+        mesh = make_mesh_2d(2, 4)  # blocks of 3 rows: not 2^2-aligned
+        with pytest.raises(ValueError, match="divisible"):
+            ring_corr_lookup(f1, f2, coords, mesh, num_levels=3, radius=3)
 
 
 class TestSpatiallyShardedTrainStep:
