@@ -154,6 +154,60 @@ def bench_mm_only():
     _time("mm_only", run, f1, f2)
 
 
+def bench_blockdiag():
+    """All 4 levels' y-einsums fused into ONE batched matmul against a
+    block-diagonal concatenated volume (built once, loop-invariant);
+    probes whether per-matmul-instance overhead dominates."""
+    f1, f2 = _pyr()
+    sizes = [(55, 128), (27, 64), (13, 32), (6, 16)]
+    yoff = [0, 55, 82, 95]
+    xoff = [0, 128, 192, 224]
+    ktot, xtot = 101, 240
+
+    @jax.jit
+    def run(f1, f2):
+        pyr = build_corr_pyramid(f1, f2, 4, R)
+        n = 2 * H8 * W8
+        vol_cat = jnp.zeros((n, ktot, xtot), jnp.float32)
+        for lvl, corr in enumerate(pyr.levels):
+            hl, wl = sizes[lvl]
+            vol_cat = jax.lax.dynamic_update_slice(
+                vol_cat, corr[..., 0], (0, yoff[lvl], xoff[lvl]))
+        coords = coords_grid(2, H8, W8)
+
+        def hats(flat):
+            ays, axs = [], []
+            for lvl in range(4):
+                c = flat / (2.0 ** lvl)
+                hl, wl = sizes[lvl]
+                ays.append(_axis_interp_matrix(c[:, 1], R, hl))
+                axs.append(_axis_interp_matrix(c[:, 0], R, wl))
+            # place each level's hat into its global K/X range
+            ay = jnp.zeros((flat.shape[0], 4, WIN, ktot), jnp.float32)
+            ax = jnp.zeros((flat.shape[0], 4, WIN, xtot), jnp.float32)
+            for lvl in range(4):
+                hl, wl = sizes[lvl]
+                ay = ay.at[:, lvl, :, yoff[lvl]:yoff[lvl] + hl].set(ays[lvl])
+                ax = ax.at[:, lvl, :, xoff[lvl]:xoff[lvl] + wl].set(axs[lvl])
+            return ay.reshape(-1, 4 * WIN, ktot), ax
+
+        def body(co, _):
+            flat = co.reshape(-1, 2)
+            ay, ax = hats(flat)
+            rows = jnp.einsum("nby,nyx->nbx", ay, vol_cat,
+                              preferred_element_type=jnp.float32)
+            rows = rows.reshape(-1, 4, WIN, xtot)
+            w = jnp.einsum("nlax,nlbx->nlab", ax, rows,
+                           preferred_element_type=jnp.float32)
+            s = w.reshape(2, H8, W8, -1)
+            return co + 0.01 * s.mean(axis=-1, keepdims=True), None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return jnp.sum(co)
+
+    _time("blockdiag", run, f1, f2)
+
+
 def main():
     print(f"platform={jax.devices()[0].platform}", file=sys.stderr)
     t = jax.jit(lambda x: jnp.sum(x))
@@ -168,6 +222,7 @@ def main():
     bench_lookup("fused", lvl_fused)
     bench_build_only()
     bench_mm_only()
+    bench_blockdiag()
 
 
 if __name__ == "__main__":

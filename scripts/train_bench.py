@@ -1,0 +1,80 @@
+"""Training-step throughput on the real chip.
+
+Chairs-stage geometry (train_standard.sh: batch 10 crop 368x496 on 2
+GPUs -> 5/GPU; here per-chip batch 6, iters 12, the mixed-precision
+recipe) for the flagship v5. Prints steps/sec and pair-iters/sec
+(batch * iters * steps/sec — the training-side throughput analog).
+
+Usage: python scripts/train_bench.py [--variant v1|v5] [--batch 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os.path as osp
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="v5")
+    ap.add_argument("--batch", type=int, default=6)
+    ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--size", type=int, nargs=2, default=(368, 496))
+    ap.add_argument("--remat", action="store_true")
+    args = ap.parse_args()
+
+    from dexiraft_tpu import config as C
+    from dexiraft_tpu.config import TrainConfig
+    from dexiraft_tpu.train.state import create_state
+    from dexiraft_tpu.train.step import make_train_step
+
+    cfg = getattr(C, f"raft_{args.variant}")(
+        mixed_precision=True, remat=args.remat)
+    h, w = args.size
+    tc = TrainConfig(name="bench", num_steps=1000, batch_size=args.batch,
+                     image_size=(h, w), iters=args.iters, lr=4e-4)
+    print(f"platform={jax.devices()[0].platform} variant={args.variant} "
+          f"batch={args.batch} {h}x{w} iters={args.iters}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    state = create_state(jax.random.PRNGKey(0), cfg, tc)
+    step_fn = make_train_step(cfg, tc)
+    print(f"init {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (args.batch, h, w, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (args.batch, h, w, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-5, 5, (args.batch, h, w, 2)),
+                            jnp.float32),
+        "valid": jnp.ones((args.batch, h, w), jnp.float32),
+    }
+
+    t0 = time.perf_counter()
+    state, metrics = step_fn(state, batch)
+    float(metrics["loss"])  # forced host sync (block_until_ready unreliable)
+    print(f"compile+step {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, metrics = step_fn(state, batch)
+        float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"steady-state {dt * 1e3:.1f} ms/step  "
+          f"{1.0 / dt:.2f} steps/s  "
+          f"{args.batch * args.iters / dt:.1f} pair-iters/s")
+
+
+if __name__ == "__main__":
+    main()
