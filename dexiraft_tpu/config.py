@@ -108,6 +108,10 @@ class TrainConfig:
     gamma: float = 0.8
     iters: int = 12
     add_noise: bool = False
+    # v1-lineage fusion (alt/train_1.py:173-176): run the SAME model on
+    # (image1, image2) and on the edge-image pair, and sum the per-iter
+    # flow predictions before the sequence loss; requires edge-pair data
+    edge_sum_fusion: bool = False
     freeze_bn: bool = False  # true for all post-chairs stages (train.py:149-150)
     val_freq: int = 5000
     sum_freq: int = 100

@@ -114,11 +114,33 @@ def validate_hd1k(eval_fn: EvalFn, dataset=None) -> Dict[str, float]:
     return {"hd1k-epe": epe, "hd1k-f1": f1}
 
 
+def validate_edgesum(eval_fn: EvalFn, dataset=None) -> Dict[str, float]:
+    """v1-lineage summed-fusion validation (alt/evaluate_1.py:84-94):
+    the model runs on the image pair AND the edge-image pair; the two
+    upsampled flows are summed before EPE. dataset must yield edge pairs
+    (EdgePairDataset samples: image1/2, edges1/2, flow) — there is no
+    default dataset, since the edge tree location is user-supplied."""
+    if dataset is None:
+        raise ValueError(
+            "validate_edgesum needs an edge-pair dataset (build one with "
+            "EdgePairDataset.from_parallel_tree); it has no default")
+    epe_all = []
+    for i in range(len(dataset)):
+        s = dataset.sample(i)
+        im_flow = _run(eval_fn, s["image1"], s["image2"], "sintel")
+        em_flow = _run(eval_fn, s["edges1"], s["edges2"], "sintel")
+        epe_all.append(_epe(im_flow + em_flow, s["flow"]).ravel())
+    epe = float(np.concatenate(epe_all).mean())
+    print(f"Validation (edge-sum fusion) EPE: {epe:.3f}")
+    return {"edgesum": epe}
+
+
 VALIDATORS = {
     "chairs": validate_chairs,
     "sintel": validate_sintel,
     "kitti": validate_kitti,
     "hd1k": validate_hd1k,
+    "edgesum": validate_edgesum,
 }
 
 

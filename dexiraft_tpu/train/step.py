@@ -52,24 +52,45 @@ def make_train_step(
     """Build the jitted train step. With a mesh, in/out shardings pin the
     batch to the 'data' axis and everything else replicated."""
     model = RAFT(cfg)
+    if tc.edge_sum_fusion and (cfg.variant != "raft" or cfg.embed_dexined):
+        raise ValueError(
+            "edge_sum_fusion is the v1 (plain 'raft') training fusion — "
+            "the model itself consumes edges in the other variants")
     tx = make_optimizer_from(tc)
     schedule = training_schedule(tc.lr, tc.num_steps)
 
     def loss_fn(params: Any, state: TrainState, batch: Batch, rng: jax.Array):
-        kwargs: Dict[str, Any] = {}
-        if "edges1" in batch:
-            kwargs = dict(edges1=batch["edges1"], edges2=batch["edges2"])
-        outputs, mutated = model.apply(
-            {"params": params, "batch_stats": state.batch_stats},
-            batch["image1"],
-            batch["image2"],
-            iters=tc.iters,
-            train=True,
-            freeze_bn=tc.freeze_bn,
-            mutable=["batch_stats"],
-            rngs={"dropout": rng},
-            **kwargs,
-        )
+        def fwd(stats, drop_rng, im1, im2, **kw):
+            return model.apply(
+                {"params": params, "batch_stats": stats},
+                im1, im2, iters=tc.iters, train=True,
+                freeze_bn=tc.freeze_bn, mutable=["batch_stats"],
+                rngs={"dropout": drop_rng}, **kw,
+            )
+
+        if tc.edge_sum_fusion:
+            if "edges1" not in batch:
+                raise ValueError("edge_sum_fusion needs edge-pair data "
+                                 "(edge_root)")
+            # v1-lineage summed fusion (alt/train_1.py:173-176): same
+            # model on the image pair and the edge-image pair, per-iter
+            # predictions summed; BN stats update through both passes
+            # sequentially, and each pass draws independent dropout masks
+            # like the reference's two separate forward calls
+            rng_img, rng_edge = jax.random.split(rng)
+            img_flow, mut1 = fwd(state.batch_stats, rng_img,
+                                 batch["image1"], batch["image2"])
+            edge_flow, mut2 = fwd(mut1.get("batch_stats", state.batch_stats),
+                                  rng_edge,
+                                  batch["edges1"], batch["edges2"])
+            outputs = img_flow + edge_flow
+            mutated = mut2
+        else:
+            kwargs: Dict[str, Any] = {}
+            if "edges1" in batch:
+                kwargs = dict(edges1=batch["edges1"], edges2=batch["edges2"])
+            outputs, mutated = fwd(state.batch_stats, rng, batch["image1"],
+                                   batch["image2"], **kwargs)
         loss, metrics = sequence_loss(outputs, batch["flow"], batch["valid"], tc.gamma)
         return loss, (metrics, mutated.get("batch_stats", state.batch_stats))
 

@@ -71,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--edge_root", default=None,
                    help="parallel tree of precomputed edge-map PNGs for the "
                         "v2/v3 data-edge contract (core/datasets_seperate.py)")
+    p.add_argument("--edge_sum_fusion", action="store_true",
+                   help="v1-lineage fusion (alt/train_1.py:173-176): run the "
+                        "model on the image pair AND the edge pair, sum the "
+                        "per-iter flows; needs --edge_root")
     p.add_argument("--restore_ckpt", default=None,
                    help="orbax dir for partial (strict=False-style) restore")
     p.add_argument("--resume", action="store_true",
@@ -111,6 +115,7 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         clip=args.clip,
         iters=args.iters,
         add_noise=args.add_noise,
+        edge_sum_fusion=args.edge_sum_fusion,
         # freeze BN for every post-chairs stage (train.py:149-150)
         freeze_bn=args.stage != "chairs",
         val_freq=args.val_freq,

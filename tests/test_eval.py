@@ -147,3 +147,29 @@ class TestSubmissions:
         flow, valid = read_flow_kitti(out / "000000_10.png")
         np.testing.assert_allclose(flow[..., 0], 2.0, atol=1 / 64)
         assert valid.min() == 1.0
+
+
+class TestEdgeSumValidator:
+    def test_sum_fusion_epe(self):
+        """alt/evaluate_1.py:84-94: flows from the image pair and the
+        edge pair are summed before EPE. A model predicting exactly half
+        the GT on each pass scores zero after summation."""
+        from dexiraft_tpu.eval.validate import validate_edgesum
+
+        class EdgeStub(_StubDense):
+            def sample(self, i, rng=None):
+                s = super().sample(i, rng)
+                s["edges1"] = s["image1"] * 0.5
+                s["edges2"] = s["image2"] * 0.5
+                return s
+
+        def half_eval_fn(im1, im2, flow_init=None):
+            low, up = _perfect_eval_fn(im1, im2)
+            return low * 0.5, up * 0.5
+
+        res = validate_edgesum(half_eval_fn, EdgeStub())
+        assert res["edgesum"] < 1e-5
+
+        res_full = validate_edgesum(_perfect_eval_fn, EdgeStub())
+        np.testing.assert_allclose(res_full["edgesum"],
+                                   np.hypot(2.0, 1.0), atol=1e-4)

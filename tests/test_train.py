@@ -133,3 +133,45 @@ class TestCheckpoint:
         merged, skipped = restore_params_into(fresh.params, restored.params)
         assert len(skipped) > 0  # architectures differ
         assert jax.tree_util.tree_structure(merged) == jax.tree_util.tree_structure(fresh.params)
+
+
+class TestEdgeSumFusion:
+    def test_step_runs_and_differs_from_plain(self):
+        """alt/train_1.py:173-176 capability: per-iter predictions of the
+        image pair and the edge-image pair are summed before the loss."""
+        import dataclasses
+
+        from dexiraft_tpu.train.state import create_state
+        from dexiraft_tpu.train.step import make_train_step
+
+        tc = dataclasses.replace(TC, edge_sum_fusion=True)
+        rng = np.random.default_rng(0)
+        batch = synthetic_batch(rng)
+        batch["edges1"] = batch["image1"] * 0.5
+        batch["edges2"] = batch["image2"] * 0.5
+
+        state = create_state(jax.random.key(0), SMALL, tc)
+        step = make_train_step(SMALL, tc)
+        state2, m = step(state, batch)
+        assert np.isfinite(float(m["loss"]))
+
+        plain_step = make_train_step(SMALL, TC)
+        plain_state = create_state(jax.random.key(0), SMALL, TC)
+        _, m_plain = plain_step(plain_state, {k: v for k, v in batch.items()
+                                              if not k.startswith("edges")})
+        # summed fusion must actually change the loss
+        assert abs(float(m["loss"]) - float(m_plain["loss"])) > 1e-6
+
+    def test_missing_edges_raises(self):
+        import dataclasses
+
+        import pytest
+
+        from dexiraft_tpu.train.state import create_state
+        from dexiraft_tpu.train.step import make_train_step
+
+        tc = dataclasses.replace(TC, edge_sum_fusion=True)
+        state = create_state(jax.random.key(0), SMALL, tc)
+        step = make_train_step(SMALL, tc)
+        with pytest.raises(ValueError, match="edge_sum_fusion"):
+            step(state, synthetic_batch(np.random.default_rng(1)))
