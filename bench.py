@@ -99,6 +99,16 @@ def main() -> None:
     image1 = jax.random.uniform(k1, (1, HEIGHT, WIDTH, 3), jnp.float32, 0, 255)
     image2 = jax.random.uniform(k2, (1, HEIGHT, WIDTH, 3), jnp.float32, 0, 255)
 
+    # the sync fetch costs one tunnel round-trip (~65-115 ms); measure
+    # that floor so it can be subtracted from the chained timings below
+    trivial = jax.jit(lambda x: jnp.sum(x))
+    float(trivial(jnp.ones((8, 8))))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        float(trivial(jnp.ones((8, 8))))
+    rtt = (time.perf_counter() - t0) / 4
+    _log(f"rtt floor {rtt * 1e3:.1f} ms")
+
     def measure(corr_impl: str):
         cfg = raft_v5(mixed_precision=(platform == "tpu"),
                       corr_impl=corr_impl)
@@ -113,60 +123,84 @@ def main() -> None:
             def forward(a, b):
                 low, up = model.apply(variables, a, b, iters=iters,
                                       train=False, test_mode=True)
-                # reduce to one scalar so the timing loop can force a
-                # host round-trip: block_until_ready over the relay
+                # reduce to one scalar: block_until_ready over the relay
                 # tunnel does not reliably block, so fetching this value
                 # is the only sync point that provably postdates the
-                # whole forward
+                # whole computation
                 return jnp.sum(low) + jnp.sum(up)
             return forward
 
-        forward = make_forward(ITERS)
-        float(forward(image1, image2))  # compile + warmup
-        _log(f"[{corr_impl}] compile+warmup done")
-        reps = 5 if platform == "tpu" else 1  # CPU fallback: keep the
-        # driver's wall-clock budget; one rep still yields a number
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            float(forward(image1, image2))
-        dt = (time.perf_counter() - t0) / reps
+        def timed_raw(fn, reps):
+            """Mean wall time of float(fn(...)) — INCLUDES one tunnel
+            round-trip per fetch."""
+            float(fn(image1, image2))  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                float(fn(image1, image2))
+            return (time.perf_counter() - t0) / reps
+
+        def rtt_corrected(dt):
+            # each fetch pays one tunnel round-trip that is measurement
+            # overhead, not compute — subtract the measured floor.
+            # (Chaining forwards inside one lax.scan to amortize the RTT
+            # instead was tried and rejected: the while-loop wrapper
+            # defeated XLA's scheduler and ran the same forward 26x
+            # slower.)
+            if dt <= rtt:
+                # the floor is measured once and RTT varies; never let
+                # the correction publish a nonsense (near-zero) timing —
+                # fall back to the uncorrected, conservative number
+                _log(f"WARNING: timing {dt * 1e3:.1f} ms <= rtt floor "
+                     f"{rtt * 1e3:.1f} ms; reporting uncorrected")
+                return dt
+            return dt - rtt
+
+        reps = 3 if platform == "tpu" else 1
+        raw = timed_raw(make_forward(ITERS), reps)
+        dt = rtt_corrected(raw)
         _log(f"[{corr_impl}] steady-state {dt * 1e3:.1f} ms / forward")
 
         loop_rate = None
         if platform == "tpu":
             # marginal per-iteration rate: isolates the refinement loop
             # from the amortized prelude (encoders/DexiNed/volume build)
-            # — the number directly comparable to a per-lookup kernel
-            fwd1 = make_forward(1)
-            float(fwd1(image1, image2))
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                float(fwd1(image1, image2))
-            dt1 = (time.perf_counter() - t0) / reps
-            if dt > dt1:
-                loop_rate = (ITERS - 1) / (dt - dt1)
-            _log(f"[{corr_impl}] prelude+1 {dt1 * 1e3:.1f} ms; "
+            # — the number directly comparable to a per-lookup kernel.
+            # Computed from the RAW difference: both timings carry the
+            # same one-RTT overhead, so it cancels exactly regardless of
+            # whether the floor correction applied to either
+            raw1 = timed_raw(make_forward(1), reps)
+            if raw > raw1:
+                loop_rate = (ITERS - 1) / (raw - raw1)
+            _log(f"[{corr_impl}] prelude+1 {rtt_corrected(raw1) * 1e3:.1f} ms; "
                  f"loop {loop_rate and round(loop_rate, 1)} iters/s")
         return ITERS / dt, loop_rate
 
-    # primary: the materialized MXU volume (the fast path on TPU); also
-    # measured: the memory-efficient on-demand path — the alt_cuda_corr
-    # analog the north-star metric names (BASELINE.json)
-    iters_per_sec, loop_ips = measure("allpairs")
-    local_ips = None
+    # both first-class corr paths are measured: the materialized MXU
+    # volume and the memory-efficient on-demand path (the alt_cuda_corr
+    # analog the north-star metric names, BASELINE.json); the faster one
+    # is the headline — a user picks it with one config flag
+    allpairs_ips, allpairs_loop = measure("allpairs")
+    local_ips = local_loop = None
     if platform == "tpu":  # secondary metric; not worth CPU-fallback time
         try:
-            local_ips, _ = measure("local")
+            local_ips, local_loop = measure("local")
         except Exception as e:  # never lose the primary number
             _log(f"[local] failed: {e}")
+
+    if local_ips is not None and local_ips > allpairs_ips:
+        iters_per_sec, loop_ips, impl = local_ips, local_loop, "local"
+    else:
+        iters_per_sec, loop_ips, impl = allpairs_ips, allpairs_loop, "allpairs"
 
     print(json.dumps({
         "metric": f"refinement_iters_per_sec_per_chip@{HEIGHT}x{WIDTH}",
         "value": round(iters_per_sec, 2),
         "unit": "iters/s",
         "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3),
+        "corr_impl": impl,
         "loop_only_iters_per_sec": (round(loop_ips, 2) if loop_ips
                                     else None),
+        "allpairs_iters_per_sec": round(allpairs_ips, 2),
         "local_corr_iters_per_sec": (round(local_ips, 2)
                                      if local_ips else None),
     }))

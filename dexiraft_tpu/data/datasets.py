@@ -337,6 +337,13 @@ def fetch_dataset(stage: str, image_size: Sequence[int],
         raise ValueError(
             f"edge_root is only supported for single-dataset stages, "
             f"not the {stage!r} mixture")
+    return wrap_with_edge_tree(ds, edge_root)
+
+
+def wrap_with_edge_tree(ds: "FlowDataset", edge_root: str) -> "EdgePairDataset":
+    """Pair every image with its edge map at the same relative path under
+    edge_root — the ONE path-mapping convention shared by training
+    (fetch_dataset) and edge-sum evaluation (eval_cli)."""
     image_root = osp.dirname(osp.commonprefix(
         [p for pair in ds.image_list for p in pair]))
     return EdgePairDataset.from_parallel_tree(ds, image_root, edge_root)

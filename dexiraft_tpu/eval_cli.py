@@ -18,7 +18,15 @@ from dexiraft_tpu.train_cli import VARIANTS, _VAL_ITERS
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dexiraft-eval")
     p.add_argument("--model", required=True, help="orbax checkpoint dir")
-    p.add_argument("--dataset", choices=["chairs", "sintel", "kitti", "hd1k"])
+    p.add_argument("--dataset",
+                   choices=["chairs", "sintel", "kitti", "hd1k", "edgesum"],
+                   help="'edgesum' = the v1-lineage summed-fusion "
+                        "validation (alt/evaluate_1.py): chairs val pairs "
+                        "+ their edge images from --edge_root, per-iter "
+                        "flows of both passes summed before EPE")
+    p.add_argument("--edge_root", default=None,
+                   help="parallel tree of edge-map PNGs (for "
+                        "--dataset edgesum)")
     p.add_argument("--submission", choices=["sintel", "kitti"])
     p.add_argument("--warm_start", action="store_true")
     p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
@@ -46,6 +54,17 @@ def load_variables(args):
     return cfg, state.variables
 
 
+def _edgesum_dataset(edge_root: str):
+    """Chairs validation pairs + their edge images from a parallel tree —
+    the data side of the v1-lineage summed-fusion validation
+    (alt/evaluate_1.py). Uses the same path-mapping convention as
+    training-side edge pairing (data.datasets.wrap_with_edge_tree)."""
+    from dexiraft_tpu.data.datasets import FlyingChairs, wrap_with_edge_tree
+
+    return wrap_with_edge_tree(FlyingChairs(None, split="validation"),
+                               edge_root)
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if not args.dataset and not args.submission:
@@ -56,13 +75,21 @@ def main(argv=None) -> None:
     cfg, variables = load_variables(args)
 
     if args.dataset:
-        from dexiraft_tpu.eval.validate import VALIDATORS
+        from dexiraft_tpu.eval.validate import run_validation
 
-        iters = args.iters or _VAL_ITERS[args.dataset]
+        dataset = None
+        if args.dataset == "edgesum":
+            if not args.edge_root:
+                raise SystemExit("--dataset edgesum needs --edge_root")
+            dataset = _edgesum_dataset(args.edge_root)
+
+        iters = args.iters or _VAL_ITERS.get(args.dataset, 24)
         step = make_eval_step(cfg, iters=iters)
-        VALIDATORS[args.dataset](
+        run_validation(
+            args.dataset,
             lambda im1, im2, flow_init=None: step(variables, im1, im2,
-                                                  flow_init=flow_init))
+                                                  flow_init=flow_init),
+            dataset)
 
     if args.submission == "sintel":
         from dexiraft_tpu.eval.submission import create_sintel_submission

@@ -144,18 +144,54 @@ class UpConvBlock(nn.Module):
         return x
 
 
+class CoFusion(nn.Module):
+    """Attention-weighted fusion over the 6 stacked scale maps — the
+    reference's unused alternative to the 1x1 block_cat fusion
+    (core/DexiNed/model.py:25-47): two conv3x3+GroupNorm(4)+relu stages
+    produce per-pixel channel attention, softmax over channels, then the
+    output is the attention-weighted sum of the input channels (a convex
+    combination per pixel). Input (B, H, W, C) -> (B, H, W, 1).
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        init = nn.initializers.normal(0.1)
+        attn = nn.Conv(64, (3, 3), padding=1, kernel_init=init,
+                       dtype=self.dtype)(x)
+        attn = nn.relu(nn.GroupNorm(num_groups=4, dtype=self.dtype)(attn))
+        attn = nn.Conv(64, (3, 3), padding=1, kernel_init=init,
+                       dtype=self.dtype)(attn)
+        attn = nn.relu(nn.GroupNorm(num_groups=4, dtype=self.dtype)(attn))
+        attn = nn.Conv(x.shape[-1], (3, 3), padding=1, kernel_init=init,
+                       dtype=self.dtype)(attn)
+        attn = jax.nn.softmax(attn, axis=-1)
+        return jnp.sum(x * attn, axis=-1, keepdims=True)
+
+
 def _maxpool_3x3_s2(x):
     # torch MaxPool2d(3, stride=2, padding=1): output size ceil(H/2)
     return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
 
 class DexiNed(nn.Module):
-    """The full network. Reference model.py:157-268."""
+    """The full network. Reference model.py:157-268.
+
+    ``fusion`` selects the final fusion head: "cat" (the reference's live
+    1x1 block_cat path, default — required for checkpoint interop) or
+    "cofusion" (the reference's defined-but-unused CoFusion attention
+    fusion, model.py:25-47, exposed here as a working capability).
+    """
 
     dtype: Any = jnp.float32
+    fusion: str = "cat"
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> List[jax.Array]:
+        if self.fusion not in ("cat", "cofusion"):
+            raise ValueError(f"unknown fusion {self.fusion!r}; "
+                             "expected 'cat' or 'cofusion'")
         dt = self.dtype
 
         block_1 = DoubleConvBlock(32, 64, stride=2, dtype=dt)(x, train)
@@ -207,7 +243,10 @@ class DexiNed(nn.Module):
 
         results = [out_1, out_2, out_3, out_4, out_5, out_6]
         block_cat = jnp.concatenate(results, axis=-1)
-        block_cat = SingleConvBlock(1, use_bn=False, dtype=dt)(block_cat, train)
+        if self.fusion == "cofusion":
+            block_cat = CoFusion(dtype=dt)(block_cat)
+        else:
+            block_cat = SingleConvBlock(1, use_bn=False, dtype=dt)(block_cat, train)
         results.append(block_cat)
         return results
 

@@ -1,0 +1,117 @@
+"""Third-round experiment: bf16 inputs for the on-demand (local) corr path.
+
+The local path recomputes the all-pairs block f1·f2ᵀ every iteration —
+MXU FLOPs, not HBM reads, so input precision is the lever: fp32 matmuls
+on TPU run as multi-pass bf16 decompositions, while native bf16 inputs
+with fp32 accumulation (preferred_element_type) are one pass.
+
+Variants (dual-stream batch B=2, 55x128x256, 4 levels, 32 chained iters):
+  fp32      inputs cast to fp32 (shipped default — reference parity,
+            core/raft.py:139-142 keeps correlation fp32)
+  bf16      f1/f2 in bf16, fp32 accumulate; hats fp32
+  bf16_all  f1/f2 AND hat matrices bf16, fp32 accumulate
+
+Also prints the max |delta| of one lookup vs fp32 to bound the accuracy
+cost of each variant.
+"""
+
+from __future__ import annotations
+
+import os.path as osp
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dexiraft_tpu.ops.corr import _axis_interp_matrix, avg_pool_2x2
+from dexiraft_tpu.ops.grid import coords_grid
+
+B, H8, W8, C = 2, 55, 128, 256
+ITERS = 32
+R = 4
+WIN = 2 * R + 1
+
+
+def _fmaps():
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (B, H8, W8, C), jnp.float32)
+    f2 = jax.random.normal(jax.random.fold_in(key, 1), (B, H8, W8, C))
+    return f1, f2
+
+
+def local_level(f1, f2, centers, in_dtype, hat_dtype):
+    """One level of the on-demand lookup at the given precisions."""
+    b, h, w, c = f1.shape
+    n = b * h * w
+    q = f1.reshape(b, h * w, c).astype(in_dtype)
+    t = f2.reshape(b, -1, c).astype(in_dtype)
+    vol = jnp.einsum("bnd,bmd->bnm", q, t,
+                     preferred_element_type=jnp.float32)
+    vol = (vol / jnp.sqrt(jnp.float32(c))).reshape(n, f2.shape[1], f2.shape[2])
+    ay = _axis_interp_matrix(centers[:, 1], R, f2.shape[1]).astype(hat_dtype)
+    ax = _axis_interp_matrix(centers[:, 0], R, f2.shape[2]).astype(hat_dtype)
+    win = jnp.einsum("nby,nyx,nax->nab", ay, vol.astype(hat_dtype), ax,
+                     preferred_element_type=jnp.float32)
+    return win.reshape(n, WIN * WIN)
+
+
+def make_run(in_dtype, hat_dtype):
+    @jax.jit
+    def run(f1, f2):
+        pyr2 = [f2]
+        for _ in range(3):
+            pyr2.append(avg_pool_2x2(pyr2[-1]))
+        coords = coords_grid(B, H8, W8)
+
+        def body(co, _):
+            flat = co.reshape(-1, 2)
+            out = [local_level(f1, lvl, flat / (2.0 ** i), in_dtype, hat_dtype)
+                   for i, lvl in enumerate(pyr2)]
+            s = jnp.concatenate(out, axis=-1).reshape(B, H8, W8, -1)
+            return co + 0.01 * s.mean(axis=-1, keepdims=True), None
+
+        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+        return jnp.sum(co)
+
+    return run
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform}", file=sys.stderr)
+    f1, f2 = _fmaps()
+
+    t = jax.jit(lambda x: jnp.sum(x))
+    float(t(jnp.ones((8, 8))))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(t(jnp.ones((8, 8))))
+    rtt = (time.perf_counter() - t0) / 3
+    print(f"       rtt: {rtt * 1e3:8.1f} ms")
+
+    # accuracy bound: one lookup at identity coords, each variant vs fp32
+    flat = coords_grid(B, H8, W8).reshape(-1, 2)
+    ref = local_level(f1, f2, flat, jnp.float32, jnp.float32)
+    for name, dts in [("bf16", (jnp.bfloat16, jnp.float32)),
+                      ("bf16_all", (jnp.bfloat16, jnp.bfloat16))]:
+        d = jnp.max(jnp.abs(local_level(f1, f2, flat, *dts) - ref))
+        r = jnp.max(jnp.abs(ref))
+        print(f"{name:>10s}: max|delta| {float(d):.4f} on max|corr| {float(r):.2f}")
+
+    for name, dts in [("fp32", (jnp.float32, jnp.float32)),
+                      ("bf16", (jnp.bfloat16, jnp.float32)),
+                      ("bf16_all", (jnp.bfloat16, jnp.bfloat16))]:
+        run = make_run(*dts)
+        float(run(f1, f2))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            float(run(f1, f2))
+        dt = (time.perf_counter() - t0) / 3 - rtt
+        print(f"{name:>10s}: {dt * 1e3:8.1f} ms total, "
+              f"{dt / ITERS * 1e3:6.2f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

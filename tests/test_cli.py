@@ -71,6 +71,40 @@ def test_train_resume_eval_roundtrip(chairs_env):
     assert up.shape == (1, 64, 64, 2)
 
 
+def test_eval_cli_edgesum_dispatch(chairs_env, capsys):
+    """--dataset edgesum wires through the validator registry: the CLI
+    builds the edge-pair chairs-val dataset from --edge_root and
+    validate_edgesum runs the dual-pass summed validation."""
+    import imageio.v2 as imageio
+
+    tmp = chairs_env
+    root = tmp / "FlyingChairs_release"
+    # flip the split to validation ("2") and add a parallel edge tree
+    (root / "chairs_split.txt").write_text("\n".join(["2"] * 8))
+    edge_root = tmp / "edges"
+    rng = np.random.default_rng(1)
+    for i in range(8):
+        for k in (1, 2):
+            p = edge_root / "data" / f"{i:05d}_img{k}.png"
+            p.parent.mkdir(parents=True, exist_ok=True)
+            imageio.imwrite(p, rng.integers(0, 256, (96, 128, 3),
+                                            dtype=np.uint8))
+
+    from dexiraft_tpu.eval_cli import _edgesum_dataset
+    from dexiraft_tpu.eval.validate import run_validation
+
+    ds = _edgesum_dataset(str(edge_root / "data"))
+    assert len(ds) == 8
+    fake = lambda im1, im2, flow_init=None: (
+        None, np.zeros(im1.shape[:3] + (2,), np.float32))
+    out = run_validation("edgesum", fake, ds)
+    assert "edgesum" in out and np.isfinite(out["edgesum"])
+
+    # the guard the registry contract requires: no dataset -> clear error
+    with pytest.raises(ValueError, match="edge-pair dataset"):
+        run_validation("edgesum", fake)
+
+
 def test_preset_resolution():
     from dexiraft_tpu.train_cli import build_parser, resolve_configs
 

@@ -71,6 +71,21 @@ def test_dexined_param_count_and_shapes():
         assert o.shape == (1, 64, 64, 1)
 
 
+def test_dexined_cofusion_head():
+    # the reference's defined-but-unused CoFusion (core/DexiNed/model.py:25-47)
+    # is a live option here; its output is a per-pixel convex combination of
+    # the 6 scale maps, so it must lie within their pointwise min/max.
+    model = DexiNed(fusion="cofusion")
+    x = jax.random.uniform(jax.random.PRNGKey(1), (1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    outs = model.apply(variables, x)
+    assert len(outs) == 7
+    scales = jnp.concatenate(outs[:6], axis=-1)
+    fused = outs[6][..., 0]
+    assert bool(jnp.all(fused <= scales.max(axis=-1) + 1e-5))
+    assert bool(jnp.all(fused >= scales.min(axis=-1) - 1e-5))
+
+
 def test_conv_transpose_matches_torch_geometry():
     torch = pytest.importorskip("torch")
     import flax.linen as nn
