@@ -1,0 +1,82 @@
+"""Memory-scalability probe: the on-demand corr path at frame sizes the
+materialized volume cannot touch.
+
+At 880x2048 the all-pairs volume would be (110*256)^2 * 4 B * 2 streams
+~ 6.3 TB — two orders of magnitude past HBM. The on-demand path with
+row chunking bounds the transient to O(chunk * W * H2 * W2) per level
+(ops/local_corr.py), the same O(HW) scaling as the reference's
+alt_cuda_corr CUDA kernel (SURVEY.md §2.2) — this probe demonstrates
+that capability on one chip.
+
+Usage: python scripts/highres_probe.py [--size 880 2048] [--chunk 8]
+       [--iters 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os.path as osp
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, nargs=2, default=(880, 2048))
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="query-row chunk for the on-demand path")
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args()
+    h, w = args.size
+    assert h % 16 == 0 and w % 16 == 0
+
+    from dexiraft_tpu.config import raft_v5
+    from dexiraft_tpu.models.raft import RAFT
+
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} size={h}x{w} chunk={args.chunk} "
+          f"iters={args.iters}", file=sys.stderr)
+
+    vol_bytes = 2 * (h // 8 * w // 8) ** 2 * 4
+    print(f"materialized volume would need {vol_bytes / 1e12:.2f} TB; "
+          f"on-demand transient ~"
+          f"{2 * args.chunk * (w // 8) * (h // 8) * (w // 8) * 4 / 1e9:.2f} GB",
+          file=sys.stderr)
+
+    cfg = raft_v5(mixed_precision=(platform == "tpu"), corr_impl="local",
+                  corr_row_chunk=args.chunk)
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    small = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    init = jax.jit(lambda r, a, b: model.init(r, a, b, iters=1, train=False))
+    variables = jax.block_until_ready(init(rng, small, small))
+    print("init done", file=sys.stderr)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    im1 = jax.random.uniform(k1, (1, h, w, 3), jnp.float32, 0, 255)
+    im2 = jax.random.uniform(k2, (1, h, w, 3), jnp.float32, 0, 255)
+
+    @jax.jit
+    def fwd(a, b):
+        low, up = model.apply(variables, a, b, iters=args.iters,
+                              train=False, test_mode=True)
+        return jnp.sum(low) + jnp.sum(up)
+
+    t0 = time.perf_counter()
+    s = float(fwd(im1, im2))
+    print(f"compile+first forward {time.perf_counter() - t0:.1f}s "
+          f"(finite={s == s})", file=sys.stderr)
+    t0 = time.perf_counter()
+    s = float(fwd(im1, im2))
+    dt = time.perf_counter() - t0
+    print(f"steady-state {dt * 1e3:.1f} ms / forward "
+          f"({args.iters} iters at {h}x{w}); finite={s == s}")
+
+
+if __name__ == "__main__":
+    main()
