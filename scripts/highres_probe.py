@@ -1,14 +1,15 @@
 """Memory-scalability probe: the on-demand corr path at frame sizes the
 materialized volume cannot touch.
 
-At 880x2048 the all-pairs volume would be (110*256)^2 * 4 B * 2 streams
-~ 6.3 TB — two orders of magnitude past HBM. The on-demand path with
-row chunking bounds the transient to O(chunk * W * H2 * W2) per level
-(ops/local_corr.py), the same O(HW) scaling as the reference's
+At the default 1440x2560 the level-0 all-pairs volume alone would be
+(180*320)^2 * 4 B * 2 streams ~ 26.5 GB (over 35 GB with the pyramid) —
+past the chip's 15.75 GB HBM before counting activations. The on-demand
+path with row chunking bounds the transient to O(chunk * W * H2 * W2)
+per level (ops/local_corr.py), the same O(HW) scaling as the reference's
 alt_cuda_corr CUDA kernel (SURVEY.md §2.2) — this probe demonstrates
 that capability on one chip.
 
-Usage: python scripts/highres_probe.py [--size 880 2048] [--chunk 8]
+Usage: python scripts/highres_probe.py [--size 1440 2560] [--chunk 8]
        [--iters 8]
 """
 
@@ -27,7 +28,7 @@ import jax.numpy as jnp
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--size", type=int, nargs=2, default=(880, 2048))
+    ap.add_argument("--size", type=int, nargs=2, default=(1440, 2560))
     ap.add_argument("--chunk", type=int, default=8,
                     help="query-row chunk for the on-demand path")
     ap.add_argument("--iters", type=int, default=8)
@@ -42,8 +43,8 @@ def main():
     print(f"platform={platform} size={h}x{w} chunk={args.chunk} "
           f"iters={args.iters}", file=sys.stderr)
 
-    vol_bytes = 2 * (h // 8 * w // 8) ** 2 * 4
-    print(f"materialized volume would need {vol_bytes / 1e12:.2f} TB; "
+    vol_bytes = 2 * (h // 8 * w // 8) ** 2 * 4  # level 0 only; pyramid +1/3
+    print(f"materialized level-0 volume would need {vol_bytes / 1e9:.1f} GB; "
           f"on-demand transient ~"
           f"{2 * args.chunk * (w // 8) * (h // 8) * (w // 8) * 4 / 1e9:.2f} GB",
           file=sys.stderr)
@@ -67,15 +68,17 @@ def main():
                               train=False, test_mode=True)
         return jnp.sum(low) + jnp.sum(up)
 
+    import math
+
     t0 = time.perf_counter()
     s = float(fwd(im1, im2))
     print(f"compile+first forward {time.perf_counter() - t0:.1f}s "
-          f"(finite={s == s})", file=sys.stderr)
+          f"(finite={math.isfinite(s)})", file=sys.stderr)
     t0 = time.perf_counter()
     s = float(fwd(im1, im2))
     dt = time.perf_counter() - t0
     print(f"steady-state {dt * 1e3:.1f} ms / forward "
-          f"({args.iters} iters at {h}x{w}); finite={s == s}")
+          f"({args.iters} iters at {h}x{w}); finite={math.isfinite(s)}")
 
 
 if __name__ == "__main__":

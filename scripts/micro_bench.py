@@ -91,6 +91,17 @@ def main() -> None:
 
     timeit("dexined_x2", dexined2, big)
 
+    # the shipped v5 configuration: ONE batched call, bf16 body
+    dexi16 = DexiNed(dtype=jnp.bfloat16)
+    dvars16 = jax.jit(lambda r, x: dexi16.init(r, x, train=False))(
+        jax.random.PRNGKey(2), dimg)
+
+    def dexined_batched_bf16(a):
+        both = jnp.concatenate([a, -a], axis=0)
+        return dexi16.apply(dvars16, both, train=False)[-1]
+
+    timeit("dexi_b_bf16", dexined_batched_bf16, big)
+
     from dexiraft_tpu.models.extractor import Encoder
 
     enc = Encoder(256, "instance", 0.0, jnp.bfloat16)

@@ -1,0 +1,65 @@
+"""The training demo's synthetic ground truth must be exact: its flow
+supervision is only correct if image1[x] == image2[x + flow[x]] by the
+same bilinear convention the model is trained against."""
+
+import os.path as osp
+import sys
+
+import numpy as np
+
+sys.path.insert(0, osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
+                            "scripts"))
+
+from train_demo import make_batch, make_pair, smooth_noise  # noqa: E402
+
+
+def test_constant_shift_pair_is_exact():
+    # force a constant integer flow: with order-1 map_coordinates the
+    # warp is then an exact pixel shift, so the pair/flow contract is
+    # verifiable bit-for-bit away from the border
+    rng = np.random.default_rng(0)
+    h, w = 48, 64
+    img2 = np.stack([smooth_noise(rng, (h, w), grid=12, lo=0, hi=255)
+                     for _ in range(3)], axis=-1)
+    flow = np.full((h, w, 2), 0.0, np.float32)
+    flow[..., 0] = 3.0  # x shift
+    flow[..., 1] = -2.0  # y shift
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    from scipy import ndimage
+
+    img1 = np.stack([
+        ndimage.map_coordinates(img2[..., c],
+                                [yy + flow[..., 1], xx + flow[..., 0]],
+                                order=1, mode="nearest")
+        for c in range(3)], axis=-1)
+    # interior: image1[y, x] == image2[y - 2, x + 3]
+    np.testing.assert_allclose(img1[4:-4, 4:-4], img2[2:-6, 7:-1],
+                               rtol=0, atol=1e-10)
+
+
+def test_make_pair_residual_epe_near_zero():
+    # the generated flow must explain image1 from image2: warping image2
+    # by the stored flow reproduces image1 (up to interpolation noise,
+    # which is tiny for smooth textures)
+    rng = np.random.default_rng(1)
+    h, w = 64, 96
+    img1, img2, flow = make_pair(rng, h, w, max_disp=4.0)
+    from scipy import ndimage
+
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    rewarp = np.stack([
+        ndimage.map_coordinates(img2[..., c],
+                                [yy + flow[..., 1], xx + flow[..., 0]],
+                                order=1, mode="nearest")
+        for c in range(3)], axis=-1)
+    assert np.abs(rewarp - img1).max() < 1e-8
+    # cubic zoom overshoots the coarse-grid range a little; bound loosely
+    assert np.abs(flow).max() <= 4.0 * 1.25
+
+
+def test_make_batch_shapes_and_dtypes():
+    b = make_batch(np.random.default_rng(2), batch=2, h=32, w=48)
+    assert b["image1"].shape == (2, 32, 48, 3)
+    assert b["flow"].shape == (2, 32, 48, 2)
+    assert b["valid"].shape == (2, 32, 48)
+    assert str(b["image1"].dtype) == "float32"
