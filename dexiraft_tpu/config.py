@@ -40,6 +40,12 @@ class RAFTConfig:
     # activations of the scanned step are recomputed instead of stored,
     # trading FLOPs for HBM (jax.checkpoint over the scan body)
     remat: bool = False
+    # rematerialize ONLY the correlation lookup: drops the per-iteration
+    # one-hot hat matrices — the dominant training-memory term (measured
+    # 5x1.57 GB with up to 15x lane padding at batch 6, 368x496; see
+    # docs/perf.md) — at a fraction of full remat's recompute cost.
+    # Numerically identical; composes with (and is implied by) remat
+    remat_lookup: bool = False
 
     @property
     def radius(self) -> int:

@@ -79,7 +79,17 @@ class RAFTStep(nn.Module):
         coords0 = coords_grid(b, pyr.ht, pyr.wd)
 
         coords1 = jax.lax.stop_gradient(carry["coords1"])  # (2B or B, h, w, 2)
-        corr = pyr(coords1)
+        if cfg.remat_lookup and not cfg.remat:
+            # recompute the lookup in backward instead of storing its
+            # intermediates (the per-iteration hat matrices dominate
+            # training memory — config.py remat_lookup). The pyramid is
+            # passed as an argument so its gradients flow normally;
+            # prevent_cse=False matches the full-remat scan convention
+            # (the scan already rules out the CSE hazard)
+            corr = jax.checkpoint(lambda p, c: p(c),
+                                  prevent_cse=False)(pyr, coords1)
+        else:
+            corr = pyr(coords1)
         flow = coords1 - jnp.concatenate([coords0, coords0], 0) if dual \
             else coords1 - coords0
         net, up_mask, delta = update_block(carry["net"], consts["inp"], corr, flow)

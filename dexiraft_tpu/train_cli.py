@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize refinement iterations in backward "
                         "(HBM savings at ~1 extra forward of FLOPs)")
+    p.add_argument("--remat_lookup", action="store_true",
+                   help="rematerialize only the correlation lookup — "
+                        "drops the per-iteration hat matrices (the "
+                        "dominant training-memory term) far cheaper than "
+                        "full --remat")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--num_steps", type=int, default=None)
@@ -100,6 +105,7 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         dropout=args.dropout,
         corr_impl=args.corr_impl,
         remat=args.remat,
+        remat_lookup=args.remat_lookup,
     )
 
     if args.preset != "none":

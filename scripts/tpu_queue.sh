@@ -1,0 +1,43 @@
+#!/bin/bash
+# Waits for the TPU relay tunnel to heal, then runs the queued on-chip
+# measurements sequentially (one TPU process at a time — see
+# .claude/skills/verify/SKILL.md). Each step gets a hard timeout so a
+# re-wedged tunnel cannot hold the queue forever.
+#
+# Usage: bash scripts/tpu_queue.sh /tmp/tpu_queue   (output dir)
+
+set -u
+OUT=${1:-/tmp/tpu_queue}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 360 python - <<'EOF' >/dev/null 2>&1
+import os, threading, sys
+threading.Timer(330, lambda: os._exit(3)).start()
+import jax, jax.numpy as jnp
+float(jax.jit(lambda x: jnp.sum(x))(jnp.ones((2, 2))))
+os._exit(0)
+EOF
+}
+
+echo "$(date -u +%H:%M:%S) waiting for tunnel" >> "$OUT/queue.log"
+until probe; do
+  echo "$(date -u +%H:%M:%S) tunnel still down" >> "$OUT/queue.log"
+  sleep 300
+done
+echo "$(date -u +%H:%M:%S) tunnel up; running queue" >> "$OUT/queue.log"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "$(date -u +%H:%M:%S) start $name" >> "$OUT/queue.log"
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  echo "$(date -u +%H:%M:%S) done $name rc=$?" >> "$OUT/queue.log"
+  sleep 30  # let the claim settle between holders
+}
+
+run micro_bench   1500 python scripts/micro_bench.py
+run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
+run train_remat   3000 python scripts/train_bench.py --variant v5 --batch 6 --remat
+run highres       2400 python scripts/highres_probe.py --iters 8
+echo "$(date -u +%H:%M:%S) queue complete" >> "$OUT/queue.log"

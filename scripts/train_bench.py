@@ -29,6 +29,7 @@ def main():
     ap.add_argument("--iters", type=int, default=12)
     ap.add_argument("--size", type=int, nargs=2, default=(368, 496))
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--remat_lookup", action="store_true")
     args = ap.parse_args()
 
     from dexiraft_tpu import config as C
@@ -37,7 +38,8 @@ def main():
     from dexiraft_tpu.train.step import make_train_step
 
     cfg = getattr(C, f"raft_{args.variant}")(
-        mixed_precision=True, remat=args.remat)
+        mixed_precision=True, remat=args.remat,
+        remat_lookup=args.remat_lookup)
     h, w = args.size
     tc = TrainConfig(name="bench", num_steps=1000, batch_size=args.batch,
                      image_size=(h, w), iters=args.iters, lr=4e-4)

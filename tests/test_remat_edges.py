@@ -7,7 +7,11 @@ from dexiraft_tpu.data.flow_io import write_flo
 
 
 class TestRemat:
-    def test_remat_matches_plain(self):
+    @pytest.mark.parametrize("kwarg", ["remat", "remat_lookup"])
+    def test_remat_matches_plain(self, kwarg):
+        """Full-iteration remat AND the selective lookup remat (which
+        drops the stored hat matrices) must both leave loss and every
+        gradient leaf numerically identical to the plain path."""
         import jax
         import jax.numpy as jnp
 
@@ -17,8 +21,8 @@ class TestRemat:
         img = jax.random.uniform(jax.random.PRNGKey(1), (1, 64, 64, 3),
                                  jnp.float32, 0, 255)
         outs = {}
-        for remat in (False, True):
-            cfg = raft_v1(small=True, remat=remat)
+        for flag in (False, True):
+            cfg = raft_v1(small=True, **{kwarg: flag})
             model = RAFT(cfg)
             variables = model.init(jax.random.PRNGKey(0), img, img,
                                    iters=1, train=False)
@@ -27,12 +31,19 @@ class TestRemat:
                 preds = model.apply(v, img, img, iters=3, train=False)
                 return jnp.sum(preds ** 2)
 
-            outs[remat] = (float(loss(variables)),
-                           jax.tree.leaves(jax.grad(loss)(variables))[0])
+            outs[flag] = (float(loss(variables)),
+                          jax.tree.leaves(jax.grad(loss)(variables)))
         np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-5)
-        np.testing.assert_allclose(np.asarray(outs[True][1]),
-                                   np.asarray(outs[False][1]),
-                                   rtol=1e-4, atol=1e-5)
+        # recompute reorders fp32 fusions; conv biases directly followed
+        # by InstanceNorm have a TRUE gradient of zero (the norm subtracts
+        # the mean), so their computed grads are cancellation residue of
+        # ~global-magnitude terms — tolerance must scale with the global
+        # gradient magnitude, not the (near-zero) leaf's own
+        gmax = max(float(np.abs(np.asarray(b)).max())
+                   for b in outs[False][1])
+        for a, b in zip(outs[True][1], outs[False][1]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4 * gmax)
 
 
 class TestFreezeBN:
