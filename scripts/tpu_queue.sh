@@ -7,15 +7,20 @@
 # Usage: bash scripts/tpu_queue.sh /tmp/tpu_queue   (output dir)
 
 set -u
-OUT=${1:-/tmp/tpu_queue}
-mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+OUT=$(readlink -f "${1:-/tmp/tpu_queue}")  # absolute: redirections below
+# must survive any caller cwd
+mkdir -p "$OUT"
 
 probe() {
+  # healthy means the REAL TPU backend answers — a CPU fallback must not
+  # count, or the queued "on-chip" numbers would silently be CPU numbers
   timeout 360 python - <<'EOF' >/dev/null 2>&1
 import os, threading, sys
 threading.Timer(330, lambda: os._exit(3)).start()
 import jax, jax.numpy as jnp
+if jax.devices()[0].platform == "cpu":
+    os._exit(4)
 float(jax.jit(lambda x: jnp.sum(x))(jnp.ones((2, 2))))
 os._exit(0)
 EOF
@@ -40,4 +45,5 @@ run micro_bench   1500 python scripts/micro_bench.py
 run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
 run train_remat   3000 python scripts/train_bench.py --variant v5 --batch 6 --remat
 run highres       2400 python scripts/highres_probe.py --iters 8
+run dexined_demo  2400 python scripts/dexined_demo.py --steps 300
 echo "$(date -u +%H:%M:%S) queue complete" >> "$OUT/queue.log"
