@@ -35,7 +35,6 @@ def make_scene(rng, size):
     img = np.stack([ndimage.zoom(rng.uniform(40, 215, (8, 8)),
                                  size / 8, order=3)[:h, :w]
                     for _ in range(3)], axis=-1)
-    mask_all = np.zeros((h, w), bool)
     edges = np.zeros((h, w), bool)
     yy, xx = np.mgrid[:h, :w]
     for _ in range(rng.integers(3, 7)):
@@ -48,8 +47,12 @@ def make_scene(rng, size):
             m = ((yy - cy) / ry) ** 2 + ((xx - cx) / rx) ** 2 < 1.0
         color = rng.uniform(0, 255, 3)
         img[m] = 0.75 * color + 0.25 * img[m]
-        mask_all |= m
-        boundary = m & ~ndimage.binary_erosion(m)
+        # a later shape overpaints earlier boundaries inside it — clear
+        # them so labels only mark edges the image actually shows
+        edges &= ~ndimage.binary_erosion(m)
+        # border_value=1: shapes clipped by the frame get no boundary
+        # label along the border (there is no contrast there)
+        boundary = m & ~ndimage.binary_erosion(m, border_value=1)
         edges |= boundary
     return img, edges.astype(np.float32)
 

@@ -7,10 +7,11 @@
 # Usage: bash scripts/tpu_queue.sh /tmp/tpu_queue   (output dir)
 
 set -u
+# resolve OUT against the CALLER's cwd, creating it first (readlink -f
+# needs the parents to exist), so redirections survive the cd below
+mkdir -p "${1:-/tmp/tpu_queue}"
+OUT=$(readlink -f "${1:-/tmp/tpu_queue}")
 cd "$(dirname "$0")/.."
-OUT=$(readlink -f "${1:-/tmp/tpu_queue}")  # absolute: redirections below
-# must survive any caller cwd
-mkdir -p "$OUT"
 
 probe() {
   # healthy means the REAL TPU backend answers — a CPU fallback must not
