@@ -49,7 +49,9 @@ def make_scene(rng, size):
         img[m] = 0.75 * color + 0.25 * img[m]
         # a later shape overpaints earlier boundaries inside it — clear
         # them so labels only mark edges the image actually shows
-        edges &= ~ndimage.binary_erosion(m)
+        # (border_value=1 keeps frame-clipped interiors in the clearing
+        # mask, matching the boundary erosion below)
+        edges &= ~ndimage.binary_erosion(m, border_value=1)
         # border_value=1: shapes clipped by the frame get no boundary
         # label along the border (there is no contrast there)
         boundary = m & ~ndimage.binary_erosion(m, border_value=1)

@@ -47,4 +47,5 @@ run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6
 run train_remat   3000 python scripts/train_bench.py --variant v5 --batch 6 --remat
 run highres       2400 python scripts/highres_probe.py --iters 8
 run dexined_demo  2400 python scripts/dexined_demo.py --steps 300
+run warmstart     2400 python scripts/warmstart_bench.py --frames 8
 echo "$(date -u +%H:%M:%S) queue complete" >> "$OUT/queue.log"
