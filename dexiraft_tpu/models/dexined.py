@@ -157,7 +157,9 @@ class CoFusion(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        init = nn.initializers.normal(0.1)
+        # the reference applies xavier_normal to every conv weight via
+        # weight_init (model.py:272-281), CoFusion's included
+        init = xavier_normal
         attn = nn.Conv(64, (3, 3), padding=1, kernel_init=init,
                        dtype=self.dtype)(x)
         attn = nn.relu(nn.GroupNorm(num_groups=4, dtype=self.dtype)(attn))
