@@ -4,6 +4,14 @@
 # .claude/skills/verify/SKILL.md). Each step gets a hard timeout so a
 # re-wedged tunnel cannot hold the queue forever.
 #
+# Job list = VERDICT round-2 priorities, in order: the official bench
+# record, micro numbers, Pallas on-chip smoke, flagship training
+# throughput, the memory-story probes, and the convergence demos.
+#
+# Touch $OUT/pause to hold the queue between jobs (frees the chip for
+# interactive work); rm it to resume. A job that exited 0 in a previous
+# queue run leaves $OUT/<name>.done and is skipped (idempotent restart).
+#
 # Usage: bash scripts/tpu_queue.sh /tmp/tpu_queue   (output dir)
 
 set -u
@@ -36,16 +44,32 @@ echo "$(date -u +%H:%M:%S) tunnel up; running queue" >> "$OUT/queue.log"
 
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
+  if [ -f "$OUT/$name.done" ]; then
+    echo "$(date -u +%H:%M:%S) skip $name (done)" >> "$OUT/queue.log"
+    return
+  fi
+  while [ -f "$OUT/pause" ]; do sleep 60; done
   echo "$(date -u +%H:%M:%S) start $name" >> "$OUT/queue.log"
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
-  echo "$(date -u +%H:%M:%S) done $name rc=$?" >> "$OUT/queue.log"
+  local rc=$?
+  [ "$rc" -eq 0 ] && touch "$OUT/$name.done"
+  echo "$(date -u +%H:%M:%S) done $name rc=$rc" >> "$OUT/queue.log"
   sleep 30  # let the claim settle between holders
 }
 
+# 1. the official metric, hardened JSON (VERDICT next-1)
+run bench_record  2700 python bench.py
+# 2. component-level forward numbers for docs/perf.md
 run micro_bench   1500 python scripts/micro_bench.py
+# 3. Pallas kernel compiled on real hardware: parity + timing (next-5)
+run tpu_smoke     1800 python scripts/tpu_smoke.py
+# 4. flagship v5 training throughput at chairs geometry (next-3)
 run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
 run train_remat   3000 python scripts/train_bench.py --variant v5 --batch 6 --remat
+# 5. memory-story probes (next-4)
 run highres       2400 python scripts/highres_probe.py --iters 8
-run dexined_demo  2400 python scripts/dexined_demo.py --steps 300
 run warmstart     2400 python scripts/warmstart_bench.py --frames 8
+# 6. convergence transcripts: flagship v5 (next-3 stretch) + DexiNed
+run v5_demo       4200 python scripts/train_demo.py --variant v5 --steps 400 --batch 2 --size 192 256 --pool 8
+run dexined_demo  2400 python scripts/dexined_demo.py --steps 300
 echo "$(date -u +%H:%M:%S) queue complete" >> "$OUT/queue.log"

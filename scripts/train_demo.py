@@ -72,6 +72,13 @@ def main():
                     help="distinct pre-uploaded batches cycled during "
                          "training (keeps the tunnel out of the step loop)")
     ap.add_argument("--log", default=None)
+    ap.add_argument("--variant", default="small",
+                    help="'small' (RAFT-small v1, the quick demo) or any "
+                         "config factory name: v1..v5. v5 is the 42.6M "
+                         "flagship — trained with remat (required at "
+                         "realistic geometry, docs/perf.md) and a lower "
+                         "lr, proving the dual-stream model converges "
+                         "end-to-end on one chip")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the axon site hook "
                          "re-pins JAX_PLATFORMS, so the env var alone "
@@ -80,7 +87,8 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
-    from dexiraft_tpu.config import TrainConfig, raft_v1
+    from dexiraft_tpu import config as cfg_mod
+    from dexiraft_tpu.config import TrainConfig
     from dexiraft_tpu.train.state import create_state
     from dexiraft_tpu.train.step import make_train_step
 
@@ -88,7 +96,8 @@ def main():
     h, w = args.size
     log_path = args.log or osp.join(
         osp.dirname(osp.dirname(osp.abspath(__file__))),
-        "logs", f"train_demo_{platform}.log")
+        "logs", f"train_demo_{args.variant}_{platform}.log"
+        if args.variant != "small" else f"train_demo_{platform}.log")
     import os
 
     os.makedirs(osp.dirname(log_path), exist_ok=True)
@@ -98,11 +107,20 @@ def main():
         print(msg)
         print(msg, file=log_f, flush=True)
 
-    cfg = raft_v1(small=True, mixed_precision=(platform == "tpu"))
+    mixed = platform == "tpu"
+    if args.variant == "small":
+        cfg = cfg_mod.raft_v1(small=True, mixed_precision=mixed)
+        lr = 4e-4
+        name = "RAFT-small v1"
+    else:
+        factory = getattr(cfg_mod, f"raft_{args.variant}")
+        cfg = factory(mixed_precision=mixed, remat=True)
+        lr = 2e-4  # the reference's chairs-stage lr (train_standard.sh)
+        name = f"RAFT {args.variant} (remat)"
     tc = TrainConfig(name="demo", num_steps=args.steps,
                      batch_size=args.batch, image_size=(h, w),
-                     iters=12, lr=4e-4, wdecay=1e-5)
-    log(f"# train_demo: RAFT-small v1, platform={platform}, "
+                     iters=12, lr=lr, wdecay=1e-5)
+    log(f"# train_demo: {name}, platform={platform}, "
         f"batch={args.batch}, {h}x{w}, iters=12, steps={args.steps}, "
         f"synthetic warped-texture pairs (exact GT)")
 
