@@ -64,16 +64,25 @@ def spatial_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
 
 
+def _put(x: Any, sharding: NamedSharding) -> jax.Array:
+    """Host array -> global sharded array.
+
+    Single-process: plain device_put. Multi-process: the host holds only
+    its jax.process_index() slice of the global batch (Loader slices at
+    decode time), so assemble the global array from per-process locals —
+    the multi-host analog of DataParallel's scatter."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
 def shard_batch_spatial(batch: Any, mesh: Mesh) -> Any:
     """device_put a host batch with (data, seq) sharding: 3D/4D image-like
     leaves shard over (batch, rows); everything else batch-only."""
     sp = spatial_sharding(mesh)
     bo = batch_sharding(mesh)
-
-    def put(x):
-        return jax.device_put(x, sp if np.ndim(x) >= 3 else bo)
-
-    return jax.tree.map(put, batch)
+    return jax.tree.map(
+        lambda x: _put(x, sp if np.ndim(x) >= 3 else bo), batch)
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -81,11 +90,23 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Device-put every leaf of a pytree fully replicated over the mesh.
+
+    Needed explicitly in multi-process runs: host-local state (e.g. from
+    create_state, identical on every process by construction) must become
+    global replicated arrays before a pjitted step can consume it."""
+    repl = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: _put(x, repl), tree)
+
+
 def shard_batch(batch: Any, mesh: Mesh, axis: str = DATA_AXIS) -> Any:
     """Device-put every leaf of a host batch with its leading dim sharded.
 
     The per-host analog of DataParallel's scatter (but zero-copy once the
-    arrays are on device; donation happens in the jitted step).
+    arrays are on device; donation happens in the jitted step). In a
+    multi-process run each host contributes its local Loader slice and
+    the result is the global batch.
     """
     sharding = batch_sharding(mesh, axis)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree.map(lambda x: _put(x, sharding), batch)

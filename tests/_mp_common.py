@@ -1,0 +1,58 @@
+"""Shared fixtures for the true multi-process distributed test.
+
+Lives outside test_*.py so both the pytest parent and the spawned child
+processes (tests/multiproc_child.py) import the exact same dataset and
+model configuration — the grad-parity assertion is only meaningful if
+every process derives identical samples and identical initial state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dexiraft_tpu.config import TrainConfig, raft_v1
+
+GLOBAL_BATCH = 8
+IMAGE_SIZE = (48, 64)
+SEED = 7
+N_STEPS = 3
+
+
+class SyntheticFlowDataset:
+    """Deterministic function of the sample index alone (the loader's
+    counter-based aug rng is deliberately ignored): any process can
+    reproduce any sample, which is what lets the parent rebuild the
+    children's global batches exactly. Each sample also carries its own
+    index so the test can verify WHICH samples each host decoded."""
+
+    def __init__(self, n: int = 32, size=IMAGE_SIZE):
+        self.n = n
+        self.h, self.w = size
+
+    def __len__(self) -> int:
+        return self.n
+
+    def sample(self, index: int, rng) -> dict:
+        del rng
+        r = np.random.default_rng(1000 + index)
+        img2 = r.uniform(0, 255, (self.h, self.w, 3)).astype(np.float32)
+        # small smooth flow; image1 as a plain shift keeps this cheap —
+        # convergence is not under test here, numerics parity is
+        flow = np.broadcast_to(
+            r.uniform(-2, 2, (1, 1, 2)), (self.h, self.w, 2)
+        ).astype(np.float32)
+        img1 = np.roll(img2, (1, 1), axis=(0, 1))
+        return {
+            "image1": img1,
+            "image2": img2,
+            "flow": np.ascontiguousarray(flow),
+            "valid": np.ones((self.h, self.w), np.float32),
+            "index": np.asarray(index, np.int32),
+        }
+
+
+def make_configs():
+    cfg = raft_v1(small=True, mixed_precision=False)
+    tc = TrainConfig(name="mp-test", num_steps=16, batch_size=GLOBAL_BATCH,
+                     image_size=IMAGE_SIZE, iters=2, lr=1e-4, wdecay=1e-5)
+    return cfg, tc

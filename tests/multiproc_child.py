@@ -1,0 +1,99 @@
+"""One process of the true multi-process distributed test.
+
+Spawned (not imported) by tests/test_multiprocess.py, twice: each child
+owns 2 virtual CPU devices, joins the other over jax.distributed through
+the package's own initialize(), decodes ONLY its host slice of every
+global batch through data.Loader, and runs the real sharded train step
+over the resulting 4-device global mesh. Results (losses, param norm,
+consumed sample indices) are written as JSON for the parent to check
+against a single-process run of the same schedule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import os.path as osp
+import sys
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--num_processes", type=int, default=2)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    from dexiraft_tpu.parallel.distributed import initialize
+
+    # the code path under test: explicit-args mode of the package's init
+    initialize(coordinator_address=f"127.0.0.1:{args.port}",
+               num_processes=args.num_processes,
+               process_id=args.process_id)
+    assert jax.process_count() == args.num_processes
+    n_devices = len(jax.devices())
+    assert n_devices == 2 * args.num_processes, jax.devices()
+
+    from tests._mp_common import GLOBAL_BATCH, N_STEPS, SEED, \
+        SyntheticFlowDataset, make_configs
+    from dexiraft_tpu.data.loader import Loader
+    from dexiraft_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+    from dexiraft_tpu.train.state import create_state
+    from dexiraft_tpu.train.step import make_train_step
+
+    loader = Loader(SyntheticFlowDataset(), GLOBAL_BATCH, shuffle=True,
+                    seed=SEED, num_workers=2,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count())
+    stream = loader.batches()
+    local_batches, consumed = [], []
+    for _ in range(N_STEPS):
+        batch = next(stream)
+        consumed.append(batch.pop("index").tolist())
+        local_batches.append(batch)
+
+    cfg, tc = make_configs()
+    mesh = make_mesh()
+    state = replicate(create_state(jax.random.PRNGKey(0), cfg, tc), mesh)
+    step_fn = make_train_step(cfg, tc, mesh)
+
+    losses = []
+    for batch in local_batches:
+        state, metrics = step_fn(state, shard_batch(batch, mesh))
+        # metrics are replicated global arrays — float() is legal on
+        # every process and synchronizes the step
+        losses.append(float(metrics["loss"]))
+
+    norm = jax.jit(
+        lambda p: jnp.sqrt(sum(jnp.sum(x ** 2)
+                               for x in jax.tree.leaves(p))))(state.params)
+    result = {
+        "process_id": args.process_id,
+        "n_devices": n_devices,
+        "losses": losses,
+        "param_norm": float(norm),
+        "consumed": consumed,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+    print("child done", json.dumps(result)[:200])
+
+
+if __name__ == "__main__":
+    main()
