@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gt_root", default=None,
                    help="ground-truth edge-map dir: --test additionally "
                         "reports ODS/OIS/AP (dexined.metrics)")
+    p.add_argument("--matching", default="assignment",
+                   choices=("assignment", "dilation"),
+                   help="TP matching rule: 'assignment' is the exact "
+                        "one-to-one correspondPixels protocol; 'dilation' "
+                        "is the fast surrogate (scores trend higher, "
+                        "docs/parity.md)")
     p.add_argument("--test_pich", action="store_true",
                    help="channel-swap ensemble test (reference testPich, "
                         "main.py:149-187): second forward on the BGR-swapped "
@@ -242,7 +248,8 @@ def test(args) -> None:
                 pred_full = cv2.resize(fused[0, ..., 0],
                                        (gt.shape[1], gt.shape[0]))
                 # streaming: only the (T, 4) counts are kept per image
-                counts.append(edge_counts(pred_full, gt > 127))
+                counts.append(edge_counts(pred_full, gt > 127,
+                                          matching=args.matching))
         total += 1
         print(f"{s['file_name']}: {dt * 1e3:.1f} ms")
     if times:

@@ -1,8 +1,16 @@
-"""ODS/OIS/AP edge metrics."""
+"""ODS/OIS/AP edge metrics — including validation of the assignment
+matching against an independent brute-force implementation of the BSDS
+correspondPixels count, and quantification of the dilation surrogate's
+upward bias."""
 
 import numpy as np
+import pytest
 
-from dexiraft_tpu.dexined.metrics import edge_counts, evaluate_edges
+from dexiraft_tpu.dexined.metrics import (
+    edge_counts,
+    evaluate_edges,
+    match_count,
+)
 
 
 def _gt_line(h=64, w=64, row=32):
@@ -11,17 +19,37 @@ def _gt_line(h=64, w=64, row=32):
     return gt
 
 
+def _brute_force_match_count(pred_mask, gt_mask, radius):
+    """Independent max-cardinality matching: scipy's min-cost assignment
+    on the dense cost matrix with a large outlier cost — the literal
+    correspondPixels formulation, feasible only on tiny fixtures."""
+    from scipy.optimize import linear_sum_assignment
+
+    p = np.argwhere(pred_mask)
+    g = np.argwhere(gt_mask)
+    if len(p) == 0 or len(g) == 0:
+        return 0
+    d = np.linalg.norm(p[:, None, :] - g[None, :, :], axis=-1)
+    # squares: matching an in-range pair always beats leaving both out
+    big = d.shape[0] * d.shape[1] + 1.0
+    cost = np.where(d <= radius, 0.0, big)
+    rows, cols = linear_sum_assignment(cost)
+    return int((d[rows, cols] <= radius).sum())
+
+
 class TestEdgeMetrics:
-    def test_perfect_prediction(self):
+    @pytest.mark.parametrize("matching", ["assignment", "dilation"])
+    def test_perfect_prediction(self, matching):
         gt = _gt_line()
-        res = evaluate_edges([gt.copy()], [gt])
+        res = evaluate_edges([gt.copy()], [gt], matching=matching)
         assert res["ODS"] > 0.99 and res["OIS"] > 0.99
         assert res["AP"] > 0.5  # PR curve is (1, 1) at all thresholds
 
-    def test_shifted_within_tolerance_still_matches(self):
+    @pytest.mark.parametrize("matching", ["assignment", "dilation"])
+    def test_shifted_within_tolerance_still_matches(self, matching):
         gt = _gt_line(row=32)
         pred = _gt_line(row=33)  # 1 px off, diag tolerance ~1 px at 64x64
-        res = evaluate_edges([pred], [gt])
+        res = evaluate_edges([pred], [gt], matching=matching)
         assert res["ODS"] > 0.99
 
     def test_garbage_prediction_scores_low(self):
@@ -44,3 +72,72 @@ class TestEdgeMetrics:
         preds = [np.clip(g + 0.3 * rng.random(g.shape), 0, 1) for g in gts]
         res = evaluate_edges(preds, gts)
         assert res["OIS"] >= res["ODS"] - 1e-9
+
+
+class TestAssignmentMatching:
+    """The correspondPixels protocol itself."""
+
+    def test_one_to_one_not_many_to_one(self):
+        # 3 predicted pixels cluster around ONE GT pixel: the toolbox
+        # counts exactly 1 TP; the dilation surrogate counts 3
+        pred = np.zeros((16, 16), np.float32)
+        gt = np.zeros((16, 16), np.float32)
+        gt[8, 8] = 1.0
+        pred[8, 7] = pred[8, 8] = pred[8, 9] = 1.0
+        assert match_count(pred > 0, gt > 0, radius=1.5) == 1
+        c_assign = edge_counts(pred, gt, np.array([0.5]), matching="assignment")
+        c_dilate = edge_counts(pred, gt, np.array([0.5]), matching="dilation")
+        assert c_assign[0, 0] == 1  # tp
+        assert c_dilate[0, 0] == 3  # the documented upward bias
+        assert c_assign[0, 2] == 1  # matched_gt (one-to-one)
+        assert c_dilate[0, 2] == 1
+
+    def test_out_of_radius_never_matches(self):
+        pred = np.zeros((32, 32), np.float32)
+        gt = np.zeros((32, 32), np.float32)
+        pred[4, 4] = 1.0
+        gt[20, 20] = 1.0
+        assert match_count(pred > 0, gt > 0, radius=3.0) == 0
+
+    def test_crossing_assignment_found(self):
+        # p0 can only match g0; p1 could match either — a greedy pairing
+        # of p1->g0 would strand p0, the maximum matching finds both
+        pred = np.zeros((16, 16), np.float32)
+        gt = np.zeros((16, 16), np.float32)
+        pred[2, 2] = 1.0   # p0: only g0 (at 2,3) in range
+        pred[2, 4] = 1.0   # p1: in range of g0 and g1
+        gt[2, 3] = 1.0     # g0
+        gt[2, 5] = 1.0     # g1
+        assert match_count(pred > 0, gt > 0, radius=1.0) == 2
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_matches_brute_force_assignment(self, seed):
+        # the sparse Hopcroft-Karp count must equal the literal min-cost
+        # assignment formulation on random small masks, several radii
+        rng = np.random.default_rng(seed)
+        pred = rng.random((24, 24)) < 0.08
+        gt = rng.random((24, 24)) < 0.08
+        for radius in (1.0, 2.0, 3.5):
+            assert match_count(pred, gt, radius) == \
+                _brute_force_match_count(pred, gt, radius)
+
+    def test_dilation_upper_bounds_assignment(self):
+        # the surrogate can only inflate scores; measure the gap on a
+        # noisy realistic-ish fixture (the number quoted in parity.md)
+        rng = np.random.default_rng(3)
+        gts, preds = [], []
+        for _ in range(4):
+            gt = np.zeros((64, 64), np.float32)
+            for r in rng.integers(8, 56, 3):
+                gt[r, 8:56] = 1.0
+            # noisy thick responses around the true lines + clutter
+            from scipy import ndimage
+
+            prob = ndimage.gaussian_filter(gt, 1.0)
+            prob = prob / prob.max() + 0.15 * rng.random(gt.shape)
+            gts.append(gt)
+            preds.append(np.clip(prob, 0, 1).astype(np.float32))
+        res_a = evaluate_edges(preds, gts, matching="assignment")
+        res_d = evaluate_edges(preds, gts, matching="dilation")
+        for k in ("ODS", "OIS", "AP"):
+            assert res_d[k] >= res_a[k] - 1e-9
