@@ -46,6 +46,11 @@ class RAFTConfig:
     # docs/perf.md) — at a fraction of full remat's recompute cost.
     # Numerically identical; composes with (and is implied by) remat
     remat_lookup: bool = False
+    # transposed-conv implementation inside the embedded DexiNed's
+    # upsamplers: "transpose" (lax.conv_transpose) or "subpixel" (the
+    # numerically identical phase-decomposed form — dense half-res convs
+    # instead of an input-dilated full-res conv; see models/dexined.py)
+    dexined_upconv: str = "transpose"
 
     @property
     def radius(self) -> int:

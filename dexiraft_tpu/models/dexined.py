@@ -31,18 +31,80 @@ def _bn(train: bool, dtype):
     return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=dtype)
 
 
-def _conv_transpose_torchlike(features: int, k: int, torch_pad: int, dtype):
+def _conv_transpose_torchlike(features: int, k: int, torch_pad: int, dtype,
+                              impl: str = "transpose", name: str | None = None):
     """ConvTranspose matching torch's output size (in-1)*2 - 2p + k == 2*in.
 
     lax.conv_transpose pads the dilated input, so torch padding p maps to
     lax padding q = k - p - 1 per side (verified against torch in tests).
+
+    ``impl="subpixel"`` computes the SAME linear map (same params, same
+    outputs — tests/test_models.py pins bit-level equivalence) as four
+    stride-1 phase convolutions + a depth-to-space interleave instead of
+    an input-dilated convolution. On TPU the dilated formulation makes
+    XLA convolve a 2x-zero-stuffed full-resolution tensor with the big
+    k x k kernel (75% zero taps, awkward tiling at 1-16 channels); the
+    phase form runs dense half-size convs with k/2 x k/2 kernels.
     """
     q = k - torch_pad - 1
+    init = xavier_normal if features > 1 else nn.initializers.normal(0.1)
+    if impl == "subpixel":
+        return SubpixelConvTranspose(features, k, q, kernel_init=init,
+                                     dtype=dtype, name=name)
     return nn.ConvTranspose(
         features, (k, k), strides=(2, 2), padding=((q, q), (q, q)),
-        kernel_init=xavier_normal if features > 1 else nn.initializers.normal(0.1),
-        dtype=dtype,
+        kernel_init=init, dtype=dtype, name=name,
     )
+
+
+class SubpixelConvTranspose(nn.Module):
+    """Exact stride-2 ConvTranspose via phase decomposition.
+
+    Param tree ({kernel: (k,k,Cin,Cout), bias: (Cout,)}) matches
+    nn.ConvTranspose, so checkpoints are interchangeable between impls
+    (callers pass an explicit ConvTranspose_N name to keep paths equal).
+
+    Derivation: conv_transpose with explicit padding q is a stride-1
+    conv over the 2x-input-dilated signal. Output row 2u+a only sees
+    kernel taps t with (2u + a - q + t) even, i.e. t = 2s + r_a where
+    r_a = (q - a) mod 2, at input rows u + s + off_a with
+    off_a = (a + r_a - q) / 2 — a plain stride-1 conv with the tap
+    subset K[r_a::2] and padding (-off_a, off_a + k/2 - 1). The four
+    (row, col) phases interleave into the 2x output.
+    """
+
+    features: int
+    k: int
+    q: int
+    kernel_init: Any = xavier_normal
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (self.k, self.k, cin, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        dt = self.dtype
+        x = x.astype(dt)
+        kernel = kernel.astype(dt)
+
+        def phase_conv(ay, ax):
+            ry, rx = (self.q - ay) % 2, (self.q - ax) % 2
+            sub = kernel[ry::2, rx::2]
+            pads = []
+            for axis, (a, r) in enumerate(((ay, ry), (ax, rx))):
+                off = (a + r - self.q) // 2
+                pads.append((-off, off + sub.shape[axis] - 1))
+            return jax.lax.conv_general_dilated(
+                x, sub, window_strides=(1, 1), padding=pads,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        rows = [jnp.stack([phase_conv(ay, 0), phase_conv(ay, 1)], axis=3)
+                for ay in (0, 1)]
+        out = jnp.stack(rows, axis=2)  # (B, H, 2, W, 2, C)
+        b, h, _, w, _, c = out.shape
+        return out.reshape(b, 2 * h, 2 * w, c) + bias.astype(dt)
 
 
 class DoubleConvBlock(nn.Module):
@@ -127,10 +189,15 @@ class DenseBlock(nn.Module):
 
 class UpConvBlock(nn.Module):
     """Stages of 1x1 conv + relu + 2x transposed conv; feature width 16
-    except the final stage which emits 1 channel. Reference model.py:81-109."""
+    except the final stage which emits 1 channel. Reference model.py:81-109.
+
+    ``upconv`` picks the transposed-conv implementation ("transpose" or
+    the numerically identical "subpixel" phase form); the param tree is
+    the same either way."""
 
     up_scale: int
     dtype: Any = jnp.float32
+    upconv: str = "transpose"
 
     @nn.compact
     def __call__(self, x):
@@ -140,7 +207,9 @@ class UpConvBlock(nn.Module):
             out_features = 1 if i == self.up_scale - 1 else 16
             x = nn.Conv(out_features, (1, 1), kernel_init=xavier_normal, dtype=self.dtype)(x)
             x = nn.relu(x)
-            x = _conv_transpose_torchlike(out_features, k, pad, self.dtype)(x)
+            x = _conv_transpose_torchlike(out_features, k, pad, self.dtype,
+                                          impl=self.upconv,
+                                          name=f"ConvTranspose_{i}")(x)
         return x
 
 
@@ -188,6 +257,7 @@ class DexiNed(nn.Module):
 
     dtype: Any = jnp.float32
     fusion: str = "cat"
+    upconv: str = "transpose"
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> List[jax.Array]:
@@ -226,12 +296,13 @@ class DexiNed(nn.Module):
         block_6_pre_dense = SingleConvBlock(256, dtype=dt)(block_5, train)
         block_6 = DenseBlock(3, 256, dtype=dt)(block_5_add, block_6_pre_dense, train)
 
-        out_1 = UpConvBlock(1, dtype=dt)(block_1)
-        out_2 = UpConvBlock(1, dtype=dt)(block_2)
-        out_3 = UpConvBlock(2, dtype=dt)(block_3)
-        out_4 = UpConvBlock(3, dtype=dt)(block_4)
-        out_5 = UpConvBlock(4, dtype=dt)(block_5)
-        out_6 = UpConvBlock(4, dtype=dt)(block_6)
+        up = self.upconv
+        out_1 = UpConvBlock(1, dtype=dt, upconv=up)(block_1)
+        out_2 = UpConvBlock(1, dtype=dt, upconv=up)(block_2)
+        out_3 = UpConvBlock(2, dtype=dt, upconv=up)(block_3)
+        out_4 = UpConvBlock(3, dtype=dt, upconv=up)(block_4)
+        out_5 = UpConvBlock(4, dtype=dt, upconv=up)(block_5)
+        out_6 = UpConvBlock(4, dtype=dt, upconv=up)(block_6)
 
         # crop deeper outputs when rounding made them overshoot
         # (reference model.py:251-257)

@@ -186,7 +186,7 @@ class RAFT(nn.Module):
             # passes), and under mixed_precision the frozen extractor runs
             # in bf16 like the encoders — the reference keeps it fp32 only
             # because it sits outside the autocast region (docs/parity.md)
-            dexined = DexiNed(dtype=dtype)
+            dexined = DexiNed(dtype=dtype, upconv=cfg.dexined_upconv)
             both = jnp.concatenate([image1, image2], axis=0)
             maps = stack_edge_maps(dexined(both, train=False))
             maps = jax.lax.stop_gradient(maps.astype(jnp.float32))

@@ -59,9 +59,12 @@ run() {  # run <name> <timeout_s> <cmd...>
 
 # 1. the official metric, hardened JSON (VERDICT next-1)
 run bench_record  2700 python bench.py
-# 2. component-level forward numbers for docs/perf.md
+# 2. the prelude profile + upconv A/B that decides the headline fix
+#    (VERDICT next-2: where do 104 ms go at a 4 ms MXU floor?)
+run prelude_profile 2700 python scripts/prelude_profile.py
+# 3. component-level forward numbers for docs/perf.md
 run micro_bench   1500 python scripts/micro_bench.py
-# 3. Pallas kernel compiled on real hardware: parity + timing (next-5)
+# 4. Pallas kernel compiled on real hardware: parity + timing (next-5)
 run tpu_smoke     1800 python scripts/tpu_smoke.py
 # 4. flagship v5 training throughput at chairs geometry (next-3)
 run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
