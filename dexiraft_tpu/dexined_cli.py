@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "one-to-one correspondPixels protocol; 'dilation' "
                         "is the fast surrogate (scores trend higher, "
                         "docs/parity.md)")
+    p.add_argument("--upconv", default="transpose",
+                   choices=("transpose", "subpixel"),
+                   help="upsampler implementation (numerically "
+                        "identical; subpixel avoids input-dilated "
+                        "convs on TPU)")
     p.add_argument("--test_pich", action="store_true",
                    help="channel-swap ensemble test (reference testPich, "
                         "main.py:149-187): second forward on the BGR-swapped "
@@ -129,7 +134,7 @@ def train(args) -> None:
                            train_list=info.train_list)
     print(f"Training DexiNed on {args.dataset}: {len(dataset)} pairs")
 
-    model = DexiNed()
+    model = DexiNed(upconv=args.upconv)
     rng = jax.random.PRNGKey(args.seed)
     dummy = jnp.zeros((1, args.img_size, args.img_size, 3), jnp.float32)
     variables = jax.jit(
@@ -193,7 +198,7 @@ def test(args) -> None:
                           img_width=info.img_width, mean_bgr=info.mean_bgr,
                           test_list=info.test_list)
 
-    model = DexiNed()
+    model = DexiNed(upconv=args.upconv)
     step = ckpt_io.latest_step(args.checkpoint)
     if step is None:
         raise FileNotFoundError(f"no checkpoint under {args.checkpoint}")

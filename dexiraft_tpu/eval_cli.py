@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["allpairs", "local", "pallas"],
                    help="'local'/'pallas' = the memory-efficient on-demand "
                         "path (the reference's --alternate_corr)")
+    p.add_argument("--dexined_upconv", default="transpose",
+                   choices=["transpose", "subpixel"],
+                   help="embedded-DexiNed upsampler implementation "
+                        "(numerically identical; see docs/perf.md)")
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--output", default=None, help="submission output dir")
     return p
@@ -48,7 +52,8 @@ def load_variables(args):
 
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
-                                 corr_impl=args.corr_impl)
+                                 corr_impl=args.corr_impl,
+                                 dexined_upconv=args.dexined_upconv)
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     state = ckpt.restore_checkpoint(args.model, template)
     return cfg, state.variables

@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "drops the per-iteration hat matrices (the "
                         "dominant training-memory term) far cheaper than "
                         "full --remat")
+    p.add_argument("--dexined_upconv", default="transpose",
+                   choices=["transpose", "subpixel"],
+                   help="embedded-DexiNed upsampler implementation "
+                        "(numerically identical; see docs/perf.md)")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--num_steps", type=int, default=None)
@@ -106,6 +110,7 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         corr_impl=args.corr_impl,
         remat=args.remat,
         remat_lookup=args.remat_lookup,
+        dexined_upconv=args.dexined_upconv,
     )
 
     if args.preset != "none":
