@@ -149,6 +149,46 @@ def main():
     print(f"{'corr_pyramid_build':>28s}: "
           f"{(raw - floor if raw > floor else raw) * 1e3:8.2f} ms", flush=True)
 
+    # --- the refinement-loop components at loop shapes (B=2: the dual
+    # streams share one batch; 55x128 = 440x1024 at 1/8) ---
+    from dexiraft_tpu.config import raft_v5
+    from dexiraft_tpu.models.update import BasicUpdateBlock
+    from dexiraft_tpu.ops.grid import coords_grid
+
+    h8, w8 = H // 8, W // 8
+    bench("update_block(GRU+heads)", BasicUpdateBlock(hidden_dim=128, dtype=dt),
+          [(2, h8, w8, 128), (2, h8, w8, 128), (2, h8, w8, 324),
+           (2, h8, w8, 2)])
+
+    for impl in ("allpairs", "local"):
+        cfg = raft_v5(mixed_precision=not args.fp32, corr_impl=impl)
+        f1 = jax.random.normal(jax.random.PRNGKey(3), (2, h8, w8, 256))
+        f2 = jax.random.normal(jax.random.PRNGKey(4), (2, h8, w8, 256))
+
+        @jax.jit
+        def lookup_once(f1, f2):
+            if impl == "allpairs":
+                pyr = build_corr_pyramid(f1, f2, 4, 4)
+            else:
+                from dexiraft_tpu.ops.local_corr import build_local_corr
+                pyr = build_local_corr(f1, f2, 4, 4, row_chunk=8)
+            coords = coords_grid(2, h8, w8) + 1.3
+            return jnp.sum(pyr(coords))
+
+        try:
+            float(lookup_once(f1, f2))
+            floor = rtt()
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                float(lookup_once(f1, f2))
+            raw = (time.perf_counter() - t0) / args.reps
+            dtc = raw - floor if raw > floor else raw
+            print(f"{'build+lookup[' + impl + ']':>28s}: {dtc * 1e3:8.2f} ms",
+                  flush=True)
+        except Exception as e:
+            print(f"{'build+lookup[' + impl + ']':>28s}: FAILED {e}",
+                  flush=True)
+
     ups = [k for k in results if k.startswith("up")]
     t_total = sum(v for k, v in results.items()
                   if k.startswith("up") and "transpose" in k)
