@@ -167,6 +167,20 @@ def main() -> None:
           f"prelude+1 {t_one * 1e3:.1f} ms; "
           f"lookup32/iter {t_lookup / ITERS * 1e3:6.1f} ms")
 
+    # --- same forward with the subpixel upconv (identical params/tree:
+    # the impls are checkpoint-interchangeable) — the e2e A/B ---
+    cfg_s = raft_v5(mixed_precision=True, corr_impl=args.impl,
+                    dexined_upconv="subpixel")
+    model_s = RAFT(cfg_s)
+
+    @jax.jit
+    def fwd_s(a, b):
+        low, up = model_s.apply(variables, a, b, iters=ITERS, train=False,
+                                test_mode=True)
+        return jnp.sum(low) + jnp.sum(up)
+
+    timeit("fwd_subpix", fwd_s, im1, im2)
+
 
 if __name__ == "__main__":
     main()
