@@ -355,6 +355,103 @@ def load_raft_pth(path: str, small: bool = False,
     return converted
 
 
+# ---------------------------------------------------------------------------
+# Export: flax variables -> torch state_dict (the inverse bridge: train on
+# TPU, hand the checkpoint back to a reference-stack consumer)
+# ---------------------------------------------------------------------------
+
+
+def _probe_mapping(template: Mapping[str, Any], convert_fn) -> Dict[str, Any]:
+    """Discover torch-key -> (collection, flax path) through the FORWARD
+    converter: run it on constant-filled stand-ins (every layout transform
+    it applies — transposes, flips — preserves a constant fill) and read
+    each key's destination off the constant. Reusing the converter as the
+    single source of truth means export can never drift from import."""
+    import jax
+
+    probes, names = {}, {}
+    for i, (key, raw) in enumerate(template.items()):
+        c = float(i + 1)
+        probes[key] = np.full(np.shape(_to_numpy(raw)), c, np.float32)
+        names[c] = key
+    converted = convert_fn(probes)
+    mapping: Dict[str, Any] = {}
+    for coll in ("params", "batch_stats"):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                dict(converted.get(coll, {})))[0]:
+            mapping[names[float(leaf.flat[0])]] = (
+                coll, tuple(p.key for p in path))
+    return mapping
+
+
+def _export_leaf(path: Tuple[str, ...], value: np.ndarray) -> np.ndarray:
+    """Invert the forward layout rules for one leaf."""
+    if path[-1] != "kernel":
+        return np.asarray(value, np.float32)
+    if "ConvTranspose" in path[-2]:
+        # forward: (in, out, kH, kW) -> transpose(2, 3, 0, 1) + spatial flip
+        return np.ascontiguousarray(
+            np.asarray(value, np.float32)[::-1, ::-1].transpose(2, 3, 0, 1))
+    return np.ascontiguousarray(
+        np.asarray(value, np.float32).transpose(3, 2, 0, 1))
+
+
+def _fetch(tree: Mapping[str, Any], path: Tuple[str, ...]) -> np.ndarray:
+    node: Any = tree
+    for p in path:
+        node = node[p]
+    return np.asarray(node)
+
+
+def _export_state_dict(variables: Mapping[str, Any],
+                       template: Mapping[str, Any],
+                       convert_fn) -> Dict[str, np.ndarray]:
+    template = dict(template)
+    stripped = {k.removeprefix("module."): k for k in template}
+    mapping = _probe_mapping(
+        {k: template[orig] for k, orig in stripped.items()}, convert_fn)
+
+    out: Dict[str, np.ndarray] = {}
+    for key, raw in template.items():
+        k = key.removeprefix("module.")
+        if k.endswith("num_batches_tracked"):
+            out[key] = _to_numpy(raw)  # dropped on import; keep as-is
+            continue
+        if k not in mapping:
+            # the bare normK the reference aliases onto downsample.1
+            # (skipped on import); both torch keys carry the same tensor,
+            # so export the shortcut-BN twin's value here
+            parts = k.split(".")
+            twin = ".".join(parts[:3] + ["downsample", "1", parts[-1]])
+            if twin not in mapping:
+                raise KeyError(f"no flax source for torch key {key!r}")
+            coll, path = mapping[twin]
+        else:
+            coll, path = mapping[k]
+        out[key] = _export_leaf(path, _fetch(variables[coll], path))
+    return out
+
+
+def export_raft_state_dict(variables: Mapping[str, Any],
+                           template: Mapping[str, Any],
+                           small: bool = False) -> Dict[str, np.ndarray]:
+    """Flax RAFT variables -> a torch state_dict (numpy values) with the
+    template's exact key set — `model.load_state_dict` it after wrapping
+    the arrays in torch tensors. Exactly inverts convert_raft_state_dict
+    (round-trip pinned bitwise in tests/test_torch_interop.py)."""
+    return _export_state_dict(
+        variables, template,
+        lambda sd: convert_raft_state_dict(sd, small=small))
+
+
+def export_dexined_state_dict(variables: Mapping[str, Any],
+                              template: Mapping[str, Any]
+                              ) -> Dict[str, np.ndarray]:
+    """Flax DexiNed variables -> a torch state_dict (numpy values)."""
+    return _export_state_dict(variables, template,
+                              convert_dexined_state_dict)
+
+
 def load_dexined_pth(path: str, verify_template=None) -> Dict[str, Any]:
     """Load a reference DexiNed .pth and convert; strips an optional
     'module.' DataParallel prefix (evaluate.py:221-222 convention)."""
