@@ -51,6 +51,11 @@ class RAFTConfig:
     # numerically identical phase-decomposed form — dense half-res convs
     # instead of an input-dilated full-res conv; see models/dexined.py)
     dexined_upconv: str = "transpose"
+    # unroll factor for the refinement-loop scan (lax.scan unroll): >1
+    # lets XLA software-pipeline consecutive iterations (fuse the next
+    # lookup's hat-matrix build with the current GRU) at the cost of
+    # code-size/compile time. Numerically identical; eval-latency knob
+    scan_unroll: int = 1
 
     @property
     def radius(self) -> int:

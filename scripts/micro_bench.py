@@ -181,6 +181,20 @@ def main() -> None:
 
     timeit("fwd_subpix", fwd_s, im1, im2)
 
+    # --- candidate shipping config: subpixel upconv + 4x unrolled scan
+    # (XLA can software-pipeline consecutive refinement iterations) ---
+    cfg_u = raft_v5(mixed_precision=True, corr_impl=args.impl,
+                    dexined_upconv="subpixel", scan_unroll=4)
+    model_u = RAFT(cfg_u)
+
+    @jax.jit
+    def fwd_u(a, b):
+        low, up = model_u.apply(variables, a, b, iters=ITERS, train=False,
+                                test_mode=True)
+        return jnp.sum(low) + jnp.sum(up)
+
+    timeit("fwd_sp_unr4", fwd_u, im1, im2)
+
 
 if __name__ == "__main__":
     main()

@@ -178,6 +178,21 @@ def test_forward_shapes_and_test_mode():
                                rtol=1e-5, atol=1e-4)
 
 
+def test_scan_unroll_identical():
+    # unroll is an XLA pipelining knob: same params tree, same outputs
+    cfg = raft_v1(small=True)
+    model, variables = init_raft(cfg)
+    from dexiraft_tpu.models.raft import RAFT
+
+    model_u = RAFT(raft_v1(small=True, scan_unroll=4))
+    img = jnp.asarray(np.random.RandomState(5).rand(1, 64, 64, 3) * 255.0)
+    a = model.apply(variables, img, img, iters=6, test_mode=True)
+    b = model_u.apply(variables, img, img, iters=6, test_mode=True)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-6, atol=1e-6)
+
+
 def test_forward_identical_images_small_flow():
     # identical frames => the model should keep flow near its zero init
     cfg = raft_v1(small=True)
