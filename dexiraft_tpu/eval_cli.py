@@ -36,6 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["allpairs", "local", "pallas"],
                    help="'local'/'pallas' = the memory-efficient on-demand "
                         "path (the reference's --alternate_corr)")
+    p.add_argument("--scan_unroll", type=int, default=1,
+                   help="refinement-scan unroll factor (XLA pipelining "
+                        "knob; numerically identical)")
     p.add_argument("--dexined_upconv", default="transpose",
                    choices=["transpose", "subpixel"],
                    help="embedded-DexiNed upsampler implementation "
@@ -53,7 +56,8 @@ def load_variables(args):
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
                                  corr_impl=args.corr_impl,
-                                 dexined_upconv=args.dexined_upconv)
+                                 dexined_upconv=args.dexined_upconv,
+                                 scan_unroll=args.scan_unroll)
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     state = ckpt.restore_checkpoint(args.model, template)
     return cfg, state.variables
