@@ -286,7 +286,7 @@ class RAFT(nn.Module):
             split_rngs={"params": False},
             in_axes=(0, nn.broadcast),
             length=iters,
-            unroll=min(cfg.scan_unroll, iters),
+            unroll=max(1, min(cfg.scan_unroll, iters)),
         )
         # pin the module name so parameter paths (and thus checkpoints and
         # interop name maps) are identical with and without remat
