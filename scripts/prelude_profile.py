@@ -75,6 +75,14 @@ def main():
                 leaves = jax.tree_util.tree_leaves(out)
                 return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves)
 
+            gflop = None
+            try:
+                cost = fwd.lower(*xs).compile().cost_analysis()
+                if cost and cost.get("flops"):
+                    gflop = cost["flops"] / 1e9
+            except Exception:
+                pass  # cost model optional; timings are the point
+
             float(fwd(*xs))  # compile
             floor = rtt()
             t0 = time.perf_counter()
@@ -83,8 +91,11 @@ def main():
             raw = (time.perf_counter() - t0) / args.reps
             dtc = raw - floor if raw > floor else raw
             results[name] = dtc
+            eff = (f"  {gflop:8.1f} GFLOP -> {gflop / dtc / 1e3:6.2f} TFLOP/s"
+                   if gflop else "")
             print(f"{name:>28s}: {dtc * 1e3:8.2f} ms   "
-                  f"(raw {raw * 1e3:.2f}, rtt {floor * 1e3:.2f})", flush=True)
+                  f"(raw {raw * 1e3:.2f}, rtt {floor * 1e3:.2f}){eff}",
+                  flush=True)
         except Exception as e:
             print(f"{name:>28s}: FAILED {type(e).__name__}: {e}", flush=True)
 
