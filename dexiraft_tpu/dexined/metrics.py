@@ -54,29 +54,33 @@ def _tolerance_radius(shape: Sequence[int], frac: float = 0.0075) -> float:
 
 
 def match_count(pred_mask: np.ndarray, gt_mask: np.ndarray,
-                radius: float) -> int:
+                radius: float, gt_tree=None) -> int:
     """Maximum number of one-to-one (pred pixel, GT pixel) pairs within
     Euclidean ``radius`` — the correspondPixels matched count.
 
     Maximum-cardinality matching via Hopcroft-Karp on the KD-tree
     neighborhood graph; exact, and sparse enough to scale to real edge
-    maps (edges only between pixels closer than a few px)."""
+    maps (edges only between pixels closer than a few px). ``gt_tree``
+    lets a threshold sweep reuse one (n_gt, cKDTree) build per image."""
     from scipy.sparse import csr_matrix
     from scipy.sparse.csgraph import maximum_bipartite_matching
     from scipy.spatial import cKDTree
 
     pred_pts = np.argwhere(pred_mask)
-    gt_pts = np.argwhere(gt_mask)
-    if len(pred_pts) == 0 or len(gt_pts) == 0:
+    if gt_tree is None:
+        gt_pts = np.argwhere(gt_mask)
+        gt_tree = cKDTree(gt_pts) if len(gt_pts) else None
+    n_gt = gt_tree.n if gt_tree is not None else 0
+    if len(pred_pts) == 0 or n_gt == 0:
         return 0
-    pairs = cKDTree(pred_pts).query_ball_tree(cKDTree(gt_pts), r=radius)
+    pairs = cKDTree(pred_pts).query_ball_tree(gt_tree, r=radius)
     indptr = np.zeros(len(pred_pts) + 1, np.int64)
     indptr[1:] = np.cumsum([len(p) for p in pairs])
     indices = np.fromiter((j for p in pairs for j in p), np.int64,
                           count=indptr[-1])
     graph = csr_matrix(
         (np.ones(len(indices), np.uint8), indices, indptr),
-        shape=(len(pred_pts), len(gt_pts)))
+        shape=(len(pred_pts), n_gt))
     match = maximum_bipartite_matching(graph, perm_type="column")
     return int((match >= 0).sum())
 
@@ -99,6 +103,11 @@ def edge_counts(pred: np.ndarray, gt: np.ndarray,
     n_gt = int(gt.sum())
     if matching == "dilation":
         gt_dil = _dilate(gt, int(round(r)))
+    else:
+        # GT is loop-invariant across the threshold sweep: one tree build
+        from scipy.spatial import cKDTree
+
+        gt_tree = cKDTree(np.argwhere(gt)) if n_gt else None
 
     out = np.zeros((len(thresholds), 4), np.int64)
     for i, t in enumerate(thresholds):
@@ -106,7 +115,7 @@ def edge_counts(pred: np.ndarray, gt: np.ndarray,
         n_pred = int(p.sum())
         if matching == "assignment":
             # one-to-one: matched pred count == matched GT count
-            tp = matched_gt = match_count(p, gt, r)
+            tp = matched_gt = match_count(p, gt, r, gt_tree=gt_tree)
         else:
             tp = int((p & gt_dil).sum())  # predictions near a GT edge
             p_dil = _dilate(p, int(round(r)))

@@ -200,7 +200,7 @@ def main():
             print(f"{'build+lookup[' + impl + ']':>28s}: FAILED {e}",
                   flush=True)
 
-    ups = [k for k in results if k.startswith("up")]
+    ups = [k for k in results if k.startswith("up") and "[" in k]
     t_total = sum(v for k, v in results.items()
                   if k.startswith("up") and "transpose" in k)
     s_total = sum(v for k, v in results.items()

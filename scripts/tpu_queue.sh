@@ -52,6 +52,11 @@ run() {  # run <name> <timeout_s> <cmd...>
   echo "$(date -u +%H:%M:%S) start $name" >> "$OUT/queue.log"
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?
+  # a CPU-fallback bench exits 0 but is NOT the on-chip record this job
+  # exists to capture — never .done-mark it, so a queue restart retries
+  if [ "$rc" -eq 0 ] && grep -q '"fallback": true' "$OUT/$name.log"; then
+    rc=9
+  fi
   [ "$rc" -eq 0 ] && touch "$OUT/$name.done"
   echo "$(date -u +%H:%M:%S) done $name rc=$rc" >> "$OUT/queue.log"
   sleep 30  # let the claim settle between holders

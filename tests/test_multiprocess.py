@@ -54,9 +54,16 @@ def child_results(tmp_path_factory):
              "--process_id", str(pid), "--out", str(out)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     logs = []
-    for p in procs:
-        stdout, _ = p.communicate(timeout=900)
-        logs.append(stdout.decode(errors="replace"))
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=900)
+            logs.append(stdout.decode(errors="replace"))
+    finally:
+        # a child deadlocked in the distributed rendezvous (e.g. its peer
+        # died pre-initialize) must not outlive the test run
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"child failed:\n{log[-3000:]}"
     return [json.loads(out.read_text()) for out in outs]
