@@ -70,14 +70,111 @@ def _tpu_responsive(timeout_s: float = 300.0) -> bool:
         return False
 
 
+# A mid-run relay death leaves device fetches blocked forever (observed
+# 2026-07-31: bench hung >15 min after "[allpairs] init done" when the
+# tunnel process died under it). The measurement therefore runs in a
+# CHILD process; the parent watches for output and, if the child goes
+# silent longer than any legitimate compile could take (or overruns the
+# hard cap), kills it and re-runs the cheap CPU fallback so the driver
+# always gets a JSON line instead of a hang. Env-overridable so the
+# watchdog itself is testable (tests/test_bench_watchdog.py).
+STALL_S = 900.0
+HARD_CAP_S = 2400.0
+
+
+def _run_child(want_cpu: bool) -> tuple[int, bool]:
+    """Spawn `bench.py` in measurement mode, forwarding its output.
+    Returns (exit code, json_emitted); the child is killed on
+    stall/overrun (rc -1). json_emitted reports whether the child got
+    its JSON record out before dying — a completed measurement whose
+    teardown hung must not be discarded or re-run."""
+    import os
+    import subprocess
+    import threading
+
+    stall_s = float(os.environ.get("BENCH_STALL_S", STALL_S))
+    hard_cap_s = float(os.environ.get("BENCH_HARD_CAP_S", HARD_CAP_S))
+    env = dict(os.environ, BENCH_CHILD="1")
+    if want_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    child = subprocess.Popen([sys.executable, __file__], env=env,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    last = [time.monotonic()]
+    json_seen = [False]
+
+    def pump(src, dst, is_stdout):
+        for line in iter(src.readline, b""):
+            last[0] = time.monotonic()
+            if is_stdout and line.lstrip().startswith(b'{"metric"'):
+                json_seen[0] = True
+            dst.buffer.write(line)
+            dst.flush()
+
+    threads = [threading.Thread(target=pump, args=(child.stdout, sys.stdout, True), daemon=True),
+               threading.Thread(target=pump, args=(child.stderr, sys.stderr, False), daemon=True)]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    rc = None
+    while True:
+        rc = child.poll()
+        if rc is not None:
+            break
+        time.sleep(min(5.0, stall_s / 2))
+        now = time.monotonic()
+        if now - last[0] > stall_s or now - t0 > hard_cap_s:
+            why = ("silent %.0fs" % (now - last[0])
+                   if now - last[0] > stall_s else "overran %.0fs" % hard_cap_s)
+            print(f"[bench] child stalled ({why}); killing", file=sys.stderr)
+            # SIGTERM first: a SIGKILLed claim holder can wedge a
+            # healthy-but-busy tunnel (see _tpu_responsive); give
+            # Python/JAX a grace window to release the device claim
+            child.terminate()
+            try:
+                child.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()
+            rc = -1
+            break
+    for t in threads:
+        t.join(timeout=5)
+    return rc, json_seen[0]
+
+
 def main() -> None:
     import os
 
+    if not os.environ.get("BENCH_CHILD"):
+        want_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        if not want_cpu and os.environ.get("JAX_PLATFORMS", "") \
+                and not _tpu_responsive():
+            print("[bench] TPU tunnel unresponsive; CPU fallback",
+                  file=sys.stderr)
+            want_cpu = True
+        rc, json_emitted = _run_child(want_cpu)
+        if rc != 0 and json_emitted:
+            # the measurement completed and the record is on stdout;
+            # only teardown failed (e.g. tunnel died after the last
+            # fetch). The record is valid — do NOT emit a second one.
+            print(f"[bench] child rc={rc} after emitting its record; "
+                  "keeping it", file=sys.stderr)
+            rc = 0
+        if rc != 0 and not want_cpu:
+            # the TPU attempt died or stalled mid-run — produce the
+            # diagnostic CPU record rather than nothing
+            print("[bench] TPU run failed; CPU fallback", file=sys.stderr)
+            rc, _ = _run_child(True)
+        sys.exit(rc if rc >= 0 else 8)
+
+    if os.environ.get("BENCH_FAKE_HANG"):
+        # test hook (tests/test_bench_watchdog.py): emit one line of
+        # progress, then block forever — the parent's stall watchdog
+        # must kill us
+        print("[bench] fake child hanging", file=sys.stderr, flush=True)
+        time.sleep(10_000)
+
     want_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
-    if not want_cpu and os.environ.get("JAX_PLATFORMS", "") \
-            and not _tpu_responsive():
-        print("[bench] TPU tunnel unresponsive; CPU fallback", file=sys.stderr)
-        want_cpu = True
     import jax
 
     if want_cpu:
@@ -124,13 +221,15 @@ def main() -> None:
             float(trivial(jnp.ones((8, 8))))
         return (time.perf_counter() - t0) / reps
 
-    def measure(corr_impl: str):
-        cfg = raft_v5(mixed_precision=on_tpu, corr_impl=corr_impl)
+    def measure(corr_impl: str, upconv: str = "transpose",
+                measure_loop: bool = True):
+        cfg = raft_v5(mixed_precision=on_tpu, corr_impl=corr_impl,
+                      dexined_upconv=upconv)
         model = RAFT(cfg)
         init = jax.jit(
             lambda r, a, b: model.init(r, a, b, iters=1, train=False))
         variables = jax.block_until_ready(init(rng, small, small))
-        _log(f"[{corr_impl}] init done")
+        _log(f"[{corr_impl}/{upconv}] init done")
 
         def make_forward(n):
             @jax.jit
@@ -177,12 +276,12 @@ def main() -> None:
         reps = 3 if on_tpu else 1
         raw, rtt = timed_block(make_forward(iters), reps)
         dt = rtt_corrected(raw, rtt)
-        _log(f"[{corr_impl}] steady-state {dt * 1e3:.1f} ms / forward "
+        _log(f"[{corr_impl}/{upconv}] steady-state {dt * 1e3:.1f} ms / forward "
              f"(raw {raw * 1e3:.1f}, rtt {rtt * 1e3:.1f})")
 
         diag = {"raw_ms": round(raw * 1e3, 2), "rtt_ms": round(rtt * 1e3, 2)}
         loop_rate = None
-        if on_tpu:
+        if on_tpu and measure_loop:
             # marginal per-iteration rate: isolates the refinement loop
             # from the amortized prelude (encoders/DexiNed/volume build)
             # — the number directly comparable to a per-lookup kernel.
@@ -195,7 +294,7 @@ def main() -> None:
                 loop_rate = (iters - 1) / signal
             diag["raw_1iter_ms"] = round(raw1 * 1e3, 2)
             diag["rtt_1iter_ms"] = round(rtt1 * 1e3, 2)
-            _log(f"[{corr_impl}] prelude+1 "
+            _log(f"[{corr_impl}/{upconv}] prelude+1 "
                  f"{rtt_corrected(raw1, rtt1) * 1e3:.1f} ms; "
                  f"loop {loop_rate and round(loop_rate, 1)} iters/s")
         return iters / dt, loop_rate, diag
@@ -203,21 +302,39 @@ def main() -> None:
     # both first-class corr paths are measured: the materialized MXU
     # volume and the memory-efficient on-demand path (the alt_cuda_corr
     # analog the north-star metric names, BASELINE.json); the faster one
-    # is the headline — a user picks it with one config flag
+    # is the headline — a user picks it with one config flag. The
+    # DexiNed upconv A/B (transposed conv vs the identical-map subpixel
+    # phase form) is measured on BOTH corr paths — the prelude gates the
+    # end-to-end headline, so a subpixel win must be visible wherever it
+    # lands. The upconv choice only changes the prelude, so the
+    # subpixel variants skip the marginal-loop (1-iter) re-measurement
+    # and inherit the loop rate of their transpose sibling.
     allpairs_ips, allpairs_loop, ap_diag = measure("allpairs")
     diag = {f"allpairs_{k}": v for k, v in ap_diag.items()}
-    local_ips = local_loop = None
-    if on_tpu:  # secondary metric; not worth CPU-fallback time
-        try:
-            local_ips, local_loop, local_diag = measure("local")
-            diag.update({f"local_{k}": v for k, v in local_diag.items()})
-        except Exception as e:  # never lose the primary number
-            _log(f"[local] failed: {e}")
+    candidates = [("allpairs", "transpose", allpairs_ips, allpairs_loop)]
+    loop_by_corr = {"allpairs": allpairs_loop}
+    if on_tpu:  # secondary metrics; not worth CPU-fallback time
+        for corr_impl, upconv, tag in (
+                ("local", "transpose", "local"),
+                ("local", "subpixel", "local_subpix"),
+                ("allpairs", "subpixel", "allpairs_subpix")):
+            try:
+                with_loop = upconv == "transpose"
+                ips, loop, d = measure(corr_impl, upconv,
+                                       measure_loop=with_loop)
+                diag.update({f"{tag}_{k}": v for k, v in d.items()})
+                diag[f"{tag}_iters_per_sec"] = round(ips, 2)
+                if loop is not None:
+                    loop_by_corr[corr_impl] = loop
+                candidates.append(
+                    (corr_impl, upconv, ips,
+                     loop if loop is not None else loop_by_corr.get(corr_impl)))
+            except Exception as e:  # never lose the primary number
+                _log(f"[{tag}] failed: {e}")
 
-    if local_ips is not None and local_ips > allpairs_ips:
-        iters_per_sec, loop_ips, impl = local_ips, local_loop, "local"
-    else:
-        iters_per_sec, loop_ips, impl = allpairs_ips, allpairs_loop, "allpairs"
+    impl, upconv_best, iters_per_sec, loop_ips = max(
+        candidates, key=lambda c: c[2])
+    local_ips = diag.get("local_iters_per_sec")
 
     print(json.dumps({
         "metric": f"refinement_iters_per_sec_per_chip@{height}x{width}",
@@ -240,6 +357,7 @@ def main() -> None:
         "baseline_iters_per_sec": BASELINE_ITERS_PER_SEC,
         "iters": iters,
         "corr_impl": impl,
+        "dexined_upconv": upconv_best,
         "loop_only_iters_per_sec": (round(loop_ips, 2) if loop_ips
                                     else None),
         # marginal refinement-loop rate (prelude EXCLUDED) over the
@@ -250,8 +368,7 @@ def main() -> None:
             round(loop_ips / BASELINE_ITERS_PER_SEC, 3) if loop_ips
             else None),
         "allpairs_iters_per_sec": round(allpairs_ips, 2),
-        "local_corr_iters_per_sec": (round(local_ips, 2)
-                                     if local_ips else None),
+        "local_corr_iters_per_sec": local_ips,
         **diag,
     }))
 

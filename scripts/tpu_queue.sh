@@ -35,12 +35,16 @@ os._exit(0)
 EOF
 }
 
-echo "$(date -u +%H:%M:%S) waiting for tunnel" >> "$OUT/queue.log"
-until probe; do
-  echo "$(date -u +%H:%M:%S) tunnel still down" >> "$OUT/queue.log"
-  sleep 300
-done
-echo "$(date -u +%H:%M:%S) tunnel up; running queue" >> "$OUT/queue.log"
+wait_for_tunnel() {
+  echo "$(date -u +%H:%M:%S) waiting for tunnel" >> "$OUT/queue.log"
+  until probe; do
+    echo "$(date -u +%H:%M:%S) tunnel still down" >> "$OUT/queue.log"
+    sleep 300
+  done
+  echo "$(date -u +%H:%M:%S) tunnel up" >> "$OUT/queue.log"
+}
+
+wait_for_tunnel
 
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
@@ -48,7 +52,18 @@ run() {  # run <name> <timeout_s> <cmd...>
     echo "$(date -u +%H:%M:%S) skip $name (done)" >> "$OUT/queue.log"
     return
   fi
-  while [ -f "$OUT/pause" ]; do sleep 60; done
+  # the relay has died mid-queue before (2026-07-31, mid-bench): without
+  # this re-probe every remaining job would hang to its full timeout in
+  # sequence against a dead endpoint — hours of nothing. Re-check the
+  # tunnel before EACH job and fall back to the 5-min wait loop if gone.
+  # Loop: a wait_for_tunnel can last hours, so re-check pause (and the
+  # tunnel) until both are simultaneously clear before starting the job.
+  while :; do
+    while [ -f "$OUT/pause" ]; do sleep 60; done
+    probe && break
+    echo "$(date -u +%H:%M:%S) tunnel lost before $name; re-waiting" >> "$OUT/queue.log"
+    wait_for_tunnel
+  done
   echo "$(date -u +%H:%M:%S) start $name" >> "$OUT/queue.log"
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?

@@ -1,0 +1,43 @@
+"""The bench harness must never hang the driver's round-end run.
+
+A relay-tunnel death mid-measurement leaves device fetches blocked
+forever (observed live: bench silent >15 min after init when the tunnel
+process died under it). bench.py therefore runs the measurement in a
+child process under a stall watchdog. These tests exercise the watchdog
+with a fake child that blocks forever (BENCH_FAKE_HANG), at a short
+test-only stall threshold (BENCH_STALL_S).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def test_watchdog_kills_stalled_child():
+    # the stall threshold must outlast interpreter startup, which can
+    # take >10 s on a loaded host — the fake child prints one line as
+    # soon as it is up, then blocks
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FAKE_HANG="1",
+               BENCH_STALL_S="40")
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, timeout=180)
+    # want_cpu path: one stall cycle, no TPU->CPU retry, exit code 8
+    assert r.returncode == 8, r.stderr.decode()
+    assert b"stalled" in r.stderr
+    assert b"fake child hanging" in r.stderr
+
+
+def test_hard_cap_kills_overrunning_child():
+    # even a child that is not silent long enough to trip the stall
+    # check must die at the hard cap
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FAKE_HANG="1",
+               BENCH_STALL_S="600", BENCH_HARD_CAP_S="25")
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, timeout=180)
+    assert r.returncode == 8, r.stderr.decode()
+    assert b"overran" in r.stderr
