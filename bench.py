@@ -79,7 +79,10 @@ def _tpu_responsive(timeout_s: float = 300.0) -> bool:
 # always gets a JSON line instead of a hang. Env-overridable so the
 # watchdog itself is testable (tests/test_bench_watchdog.py).
 STALL_S = 900.0
-HARD_CAP_S = 2400.0
+# must leave room for the CPU-fallback child (~5 min incl. interpreter
+# start + compile) inside the queue's outer `timeout` on bench_record
+# (scripts/tpu_queue.sh) — cap + fallback < queue timeout
+HARD_CAP_S = 1950.0
 
 
 def _run_child(want_cpu: bool) -> tuple[int, bool]:
@@ -99,6 +102,22 @@ def _run_child(want_cpu: bool) -> tuple[int, bool]:
         env["JAX_PLATFORMS"] = "cpu"
     child = subprocess.Popen([sys.executable, __file__], env=env,
                              stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+    # the queue's outer `timeout` SIGTERMs only THIS parent; without a
+    # handler the measurement grandchild would be orphaned still holding
+    # the TPU claim — forward the kill before dying
+    import signal
+
+    def _on_term(signum, frame):
+        child.terminate()
+        try:
+            child.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            child.kill()
+        sys.exit(128 + signum)
+
+    prev_handlers = {s: signal.signal(s, _on_term)
+                     for s in (signal.SIGTERM, signal.SIGINT)}
     last = [time.monotonic()]
     json_seen = [False]
 
@@ -139,6 +158,8 @@ def _run_child(want_cpu: bool) -> tuple[int, bool]:
             break
     for t in threads:
         t.join(timeout=5)
+    for s, h in prev_handlers.items():
+        signal.signal(s, h)
     return rc, json_seen[0]
 
 

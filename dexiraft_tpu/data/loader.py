@@ -30,6 +30,26 @@ def _stack(samples) -> Batch:
     return {k: np.stack([s[k] for s in samples]) for k in keys}
 
 
+# --- process-worker plumbing -------------------------------------------------
+# Decoding is a pure function of (seed, epoch, index) — the counter-based
+# PRNG keys make a sample reproducible in ANY worker, so thread and
+# process pools yield bit-identical batches. The dataset is shipped to
+# each worker ONCE via the pool initializer (under the default fork
+# context it is inherited for free); per-task traffic is just two ints
+# out and the decoded arrays back.
+_WORKER_STATE: dict = {}
+
+
+def _process_worker_init(dataset, seed: int) -> None:
+    _WORKER_STATE["dataset"] = dataset
+    _WORKER_STATE["seed"] = seed
+
+
+def _process_decode(epoch: int, index: int) -> Batch:
+    rng = np.random.default_rng((_WORKER_STATE["seed"], epoch, index))
+    return _WORKER_STATE["dataset"].sample(int(index), rng)
+
+
 class Loader:
     """Iterable over batches of a FlowDataset(-like) object.
 
@@ -49,6 +69,8 @@ class Loader:
         prefetch: int = 4,
         process_index: int = 0,
         process_count: int = 1,
+        worker_mode: str = "thread",
+        mp_start_method: str = "fork",
     ):
         if batch_size % process_count:
             raise ValueError(
@@ -63,6 +85,15 @@ class Loader:
         self.prefetch = prefetch
         self.process_index = process_index
         self.process_count = process_count
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be thread|process, got {worker_mode!r}")
+        # "process" sidesteps the GIL for the Python/numpy share of
+        # decode+augment (the reference's DataLoader runs 4 worker
+        # PROCESSES for the same reason, core/datasets.py:234). Prefer
+        # constructing the Loader BEFORE heavy jax/TPU init when using
+        # the default fork start method, or pass mp_start_method="spawn".
+        self.worker_mode = worker_mode
+        self.mp_start_method = mp_start_method
 
     def __len__(self) -> int:
         n = len(self.dataset) // self.global_batch
@@ -82,7 +113,19 @@ class Loader:
 
     def batches(self, start_epoch: int = 0) -> Iterator[Batch]:
         """Endless batch stream; this host's slice of each global batch."""
-        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        if self.worker_mode == "process":
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            pool = ProcessPoolExecutor(
+                max_workers=self.num_workers,
+                mp_context=mp.get_context(self.mp_start_method),
+                initializer=_process_worker_init,
+                initargs=(self.dataset, self.seed))
+            submit = lambda epoch, i: pool.submit(_process_decode, epoch, i)  # noqa: E731
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            submit = lambda epoch, i: pool.submit(self._decode, epoch, i)  # noqa: E731
         out: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
@@ -103,7 +146,7 @@ class Loader:
                     ids = order[lo:lo + self.local_batch]
                     if len(ids) == 0:
                         continue
-                    futs = [pool.submit(self._decode, epoch, i) for i in ids]
+                    futs = [submit(epoch, i) for i in ids]
                     while not stop.is_set():  # never park forever on put
                         try:
                             out.put(futs, timeout=0.1)

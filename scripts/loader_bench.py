@@ -1,0 +1,118 @@
+"""Host data-pipeline throughput: can the loader keep the chip fed?
+
+The reference trains from a 4-worker torch DataLoader
+(core/datasets.py:233-234). Here `data.Loader` decodes and augments in
+a thread pool ahead of the step. This benchmark measures the full host
+path — PPM/flo decode -> dense augmentor (photometric, eraser, scale/
+stretch/flip) -> crop -> batch stack — at the chairs-stage training
+recipe (batch 6, crop 368x496, train_standard.sh:3) over a synthetic
+FlyingChairs tree at the native 384x512 geometry.
+
+The training step is host-bound only if its on-chip steps/sec exceeds
+the batches/sec printed here; the margin is the headroom for scaling
+batch or worker count. CPU-only — no TPU required.
+
+Usage: python scripts/loader_bench.py [--pairs 48] [--batches 60]
+       [--batch 6] [--workers 1 4 8] [--height 384] [--width 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os.path as osp
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+import numpy as np
+
+
+def build_chairs_tree(root: str, pairs: int, h: int, w: int) -> str:
+    """Synthetic FlyingChairs layout: data/NNNNN_img{1,2}.ppm +
+    NNNNN_flow.flo + chairs_split.txt (all marked train)."""
+    import imageio.v2 as imageio
+
+    from dexiraft_tpu.data.flow_io import write_flo
+
+    data = osp.join(root, "data")
+    import os
+
+    os.makedirs(data, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(pairs):
+        for k in (1, 2):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            imageio.imwrite(osp.join(data, f"{i:05d}_img{k}.ppm"), img)
+        flow = rng.normal(scale=4.0, size=(h, w, 2)).astype(np.float32)
+        write_flo(osp.join(data, f"{i:05d}_flow.flo"), flow)
+    with open(osp.join(root, "chairs_split.txt"), "w") as f:
+        f.write("\n".join(["1"] * pairs))
+    return data
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pairs", type=int, default=48)
+    ap.add_argument("--batches", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=6)
+    ap.add_argument("--workers", type=int, nargs="+", default=[1, 4, 8])
+    ap.add_argument("--modes", nargs="+", default=["thread", "process"],
+                    choices=["thread", "process"])
+    ap.add_argument("--height", type=int, default=384)
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--crop", type=int, nargs=2, default=None,
+                    help="crop size (default: chairs recipe 368x496, "
+                    "clamped to the synthetic geometry)")
+    args = ap.parse_args()
+
+    from dexiraft_tpu.data.datasets import FlyingChairs
+    from dexiraft_tpu.data.loader import Loader
+
+    crop = args.crop or (min(368, args.height - 16), min(496, args.width - 16))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        t0 = time.perf_counter()
+        data = build_chairs_tree(tmp, args.pairs, args.height, args.width)
+        print(f"[loader_bench] built {args.pairs} synthetic pairs "
+              f"({args.height}x{args.width}) in "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+        # chairs-stage augmentation recipe (datasets.py:_fetch_plain)
+        aug = dict(crop_size=tuple(crop), min_scale=-0.1, max_scale=1.0,
+                   do_flip=True)
+        ds = FlyingChairs(aug, split="training", root=data)
+
+        for mode in args.modes:
+            for workers in args.workers:
+                loader = Loader(ds, args.batch, num_workers=workers,
+                                prefetch=2 * workers, worker_mode=mode)
+                it = loader.batches()
+                for _ in range(5):  # warm the pool + page cache
+                    next(it)
+                t0 = time.perf_counter()
+                nbytes = 0
+                for _ in range(args.batches):
+                    b = next(it)
+                    nbytes += sum(v.nbytes for v in b.values())
+                dt = time.perf_counter() - t0
+                rate = args.batches / dt
+                it.close()
+                print(json.dumps({
+                    "metric": "loader_batches_per_sec",
+                    "value": round(rate, 2),
+                    "unit": "batches/s",
+                    "imgs_per_sec": round(rate * args.batch * 2, 1),
+                    "mb_per_sec": round(nbytes / dt / 1e6, 1),
+                    "batch": args.batch,
+                    "crop": list(crop),
+                    "worker_mode": mode,
+                    "num_workers": workers,
+                    "pairs": args.pairs,
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

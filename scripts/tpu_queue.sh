@@ -56,13 +56,15 @@ run() {  # run <name> <timeout_s> <cmd...>
   # this re-probe every remaining job would hang to its full timeout in
   # sequence against a dead endpoint — hours of nothing. Re-check the
   # tunnel before EACH job and fall back to the 5-min wait loop if gone.
-  # Loop: a wait_for_tunnel can last hours, so re-check pause (and the
-  # tunnel) until both are simultaneously clear before starting the job.
+  # A wait_for_tunnel can last hours, so re-check pause after it; its
+  # own successful probe stands — don't pay a second probe unless the
+  # pause file appeared in the meantime.
   while :; do
     while [ -f "$OUT/pause" ]; do sleep 60; done
     probe && break
     echo "$(date -u +%H:%M:%S) tunnel lost before $name; re-waiting" >> "$OUT/queue.log"
     wait_for_tunnel
+    [ -f "$OUT/pause" ] || break
   done
   echo "$(date -u +%H:%M:%S) start $name" >> "$OUT/queue.log"
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
@@ -77,8 +79,10 @@ run() {  # run <name> <timeout_s> <cmd...>
   sleep 30  # let the claim settle between holders
 }
 
-# 1. the official metric, hardened JSON (VERDICT next-1)
-run bench_record  2700 python bench.py
+# 1. the official metric, hardened JSON (VERDICT next-1). 3000s outer
+#    timeout > bench's own HARD_CAP_S (1950) + CPU-fallback time, so the
+#    watchdogged parent, not this timeout, is what ends a stuck run
+run bench_record  3000 python bench.py
 # 2. the prelude profile + upconv A/B that decides the headline fix
 #    (VERDICT next-2: where do 104 ms go at a 4 ms MXU floor?)
 run prelude_profile 2700 python scripts/prelude_profile.py

@@ -32,6 +32,45 @@ def test_watchdog_kills_stalled_child():
     assert b"fake child hanging" in r.stderr
 
 
+def test_sigterm_forwards_to_measurement_child():
+    # the queue's outer `timeout` signals only the parent; the parent
+    # must kill the measurement grandchild before dying or it would be
+    # orphaned still holding the TPU claim
+    import glob
+    import time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FAKE_HANG="1",
+               BENCH_STALL_S="600")
+    p = subprocess.Popen([sys.executable, BENCH], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 120
+        saw_child = False
+        while time.time() < deadline:
+            line = p.stderr.readline()
+            if b"fake child hanging" in line:
+                saw_child = True
+                break
+        assert saw_child, "fake child never started"
+        p.terminate()
+        assert p.wait(timeout=30) == 143  # 128 + SIGTERM
+        time.sleep(1.0)
+        # no orphaned bench.py process may remain
+        orphans = []
+        for cmd in glob.glob("/proc/[0-9]*/cmdline"):
+            try:
+                with open(cmd, "rb") as f:
+                    argv = f.read().split(b"\0")
+            except OSError:
+                continue
+            if any(a == BENCH.encode() for a in argv):
+                orphans.append(cmd)
+        assert not orphans, orphans
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
 def test_hard_cap_kills_overrunning_child():
     # even a child that is not silent long enough to trip the stall
     # check must die at the hard cap

@@ -232,6 +232,28 @@ class TestLoader:
         for k in b1:
             np.testing.assert_array_equal(b1[k], b2[k])
 
+    def test_process_workers_match_thread_workers(self, tmp_path):
+        # decoding is a pure function of (seed, epoch, index), so a
+        # process pool must yield bit-identical batches to the thread
+        # pool — the GIL-free path cannot change the data
+        data = _make_chairs_tree(tmp_path)
+        ds = FlyingChairs(dict(crop_size=(64, 96)), split="training", root=str(data))
+        # spawn, not fork: this pytest process has jax/XLA initialized
+        # (conftest + earlier modules), and forking after XLA's thread
+        # pools exist can deadlock the worker
+        it_t = iter(Loader(ds, 2, seed=7, num_workers=2, worker_mode="thread"))
+        it_p = iter(Loader(ds, 2, seed=7, num_workers=2, worker_mode="process",
+                           mp_start_method="spawn"))
+        try:
+            for _ in range(3):
+                bt, bp = next(it_t), next(it_p)
+                assert set(bt) == set(bp)
+                for k in bt:
+                    np.testing.assert_array_equal(bt[k], bp[k])
+        finally:
+            it_t.close()
+            it_p.close()
+
     def test_host_sharding_disjoint(self, tmp_path):
         data = _make_chairs_tree(tmp_path)
         ds = FlyingChairs(None, split="training", root=str(data))

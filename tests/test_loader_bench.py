@@ -1,0 +1,26 @@
+"""Smoke for scripts/loader_bench.py — the host-throughput measurement
+must keep working as the data pipeline evolves (it is the evidence that
+the chip, not the host, is the training bottleneck)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "loader_bench.py")
+
+
+def test_loader_bench_smoke():
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--pairs", "6", "--batches", "3",
+         "--batch", "2", "--workers", "2", "--height", "96", "--width",
+         "128", "--crop", "64", "96", "--modes", "thread"],
+        capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()
+    lines = [l for l in r.stdout.decode().splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "loader_batches_per_sec"
+    assert rec["value"] > 0
+    assert rec["crop"] == [64, 96]
