@@ -17,6 +17,25 @@ IMAGE_SIZE = (48, 64)
 SEED = 7
 N_STEPS = 3
 
+# --- cross-process context-parallel (ring) test geometry ---------------------
+# H must divide by n_seq * 2^(CP_LEVELS-1) = 4 * 4 (ring_corr_lookup's
+# pooling-alignment requirement)
+CP_B, CP_H, CP_W, CP_C = 1, 16, 16, 16
+CP_LEVELS, CP_RADIUS = 3, 3
+
+
+def cp_full_inputs():
+    """Deterministic full-size ring-test inputs — identical in every
+    child process and in the parent's unsharded reference."""
+    rng = np.random.default_rng(42)
+    f1 = rng.normal(size=(CP_B, CP_H, CP_W, CP_C)).astype(np.float32)
+    f2 = rng.normal(size=(CP_B, CP_H, CP_W, CP_C)).astype(np.float32)
+    ys, xs = np.meshgrid(np.arange(CP_H), np.arange(CP_W), indexing="ij")
+    base = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+    coords = base + rng.uniform(
+        -2, 2, size=(CP_B, CP_H, CP_W, 2)).astype(np.float32)
+    return f1, f2, coords
+
 
 class SyntheticFlowDataset:
     """Deterministic function of the sample index alone (the loader's

@@ -1,0 +1,85 @@
+"""One process of the cross-process CONTEXT-PARALLEL test.
+
+Spawned (never imported) twice by tests/test_multiprocess.py:
+2 processes x 2 virtual CPU devices = a (data=1, seq=4) global mesh
+whose ring ppermute hops CROSS THE PROCESS BOUNDARY — the DCN/multi-host
+analog of the single-process ring tests in test_context_parallel.py.
+Each child builds the globally row-sharded inputs from its
+process-local rows, runs ring_corr_lookup under jit, and dumps its
+addressable output rows for the parent to reassemble and pin against
+the unsharded lookup. Geometry and inputs live in tests/_mp_common.py
+(side-effect free) so the parent never has to import this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import os.path as osp
+import sys
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--num_processes", type=int, default=2)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    from tests._mp_common import CP_H, CP_LEVELS, CP_RADIUS, cp_full_inputs
+
+    from dexiraft_tpu.parallel.distributed import initialize
+
+    initialize(coordinator_address=f"127.0.0.1:{args.port}",
+               num_processes=args.num_processes,
+               process_id=args.process_id)
+    n_seq = len(jax.devices())
+    assert n_seq == 4, jax.devices()
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dexiraft_tpu.parallel.context import ring_corr_lookup
+    from dexiraft_tpu.parallel.mesh import make_mesh_2d
+
+    mesh = make_mesh_2d(1, n_seq)
+    f1, f2, coords = cp_full_inputs()
+
+    def rows_global(arr):
+        # each process contributes only the rows its devices own —
+        # nothing outside the local slice is ever materialized globally
+        sh = NamedSharding(mesh, P(None, "seq"))
+        lo = jax.process_index() * (CP_H // args.num_processes)
+        hi = lo + CP_H // args.num_processes
+        return jax.make_array_from_process_local_data(
+            sh, arr[:, lo:hi], arr.shape)
+
+    out = jax.jit(lambda a, b, c: ring_corr_lookup(
+        a, b, c, mesh, num_levels=CP_LEVELS, radius=CP_RADIUS))(
+            rows_global(f1), rows_global(f2), rows_global(coords))
+    jax.block_until_ready(out)
+
+    rows = {}
+    for shard in out.addressable_shards:
+        r0 = shard.index[1].start or 0
+        rows[str(r0)] = np.asarray(shard.data)
+    np.savez(args.out, **rows)
+    print(f"child {args.process_id} wrote {sorted(rows)} shapes "
+          f"{[v.shape for v in rows.values()]}")
+
+
+if __name__ == "__main__":
+    main()

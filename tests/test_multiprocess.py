@@ -40,23 +40,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.fixture(scope="module")
-def child_results(tmp_path_factory):
-    out_dir = tmp_path_factory.mktemp("mp")
+def _run_children(script: str, outs, timeout: float = 900.0) -> None:
+    """Spawn one process per out-path with a shared rendezvous port,
+    wait for all, and assert success. XLA_FLAGS is stripped so the
+    children control their own virtual device count."""
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    procs, outs = [], []
-    for pid in range(2):
-        out = out_dir / f"child{pid}.json"
-        outs.append(out)
+    procs = []
+    for pid, out in enumerate(outs):
         procs.append(subprocess.Popen(
-            [sys.executable, _CHILD, "--port", str(port),
+            [sys.executable, script, "--port", str(port),
              "--process_id", str(pid), "--out", str(out)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     logs = []
     try:
         for p in procs:
-            stdout, _ = p.communicate(timeout=900)
+            stdout, _ = p.communicate(timeout=timeout)
             logs.append(stdout.decode(errors="replace"))
     finally:
         # a child deadlocked in the distributed rendezvous (e.g. its peer
@@ -66,6 +65,13 @@ def child_results(tmp_path_factory):
                 p.kill()
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"child failed:\n{log[-3000:]}"
+
+
+@pytest.fixture(scope="module")
+def child_results(tmp_path_factory):
+    out_dir = tmp_path_factory.mktemp("mp")
+    outs = [out_dir / f"child{pid}.json" for pid in range(2)]
+    _run_children(_CHILD, outs)
     return [json.loads(out.read_text()) for out in outs]
 
 
@@ -95,6 +101,50 @@ def test_losses_replicated_across_processes(child_results):
         child_results[1]["losses"], rel=1e-6)
     assert child_results[0]["param_norm"] == pytest.approx(
         child_results[1]["param_norm"], rel=1e-6)
+
+
+def test_ring_lookup_across_process_boundary(tmp_path):
+    """Cross-process CONTEXT parallelism: a (data=1, seq=4) ring over
+    2 processes x 2 devices — the ppermute hops between devices 1 and 2
+    cross the process boundary (the DCN/multi-host analog the
+    single-process ring tests cannot cover). The reassembled sharded
+    output must equal the unsharded lookup bit-for-bit in fp32 tolerance.
+    """
+    from tests._mp_common import (
+        CP_B,
+        CP_H,
+        CP_LEVELS,
+        CP_RADIUS,
+        CP_W,
+        cp_full_inputs,
+    )
+
+    cp_child = osp.join(osp.dirname(osp.abspath(__file__)),
+                        "multiproc_cp_child.py")
+    outs = [tmp_path / f"cp{pid}.npz" for pid in range(2)]
+    _run_children(cp_child, outs)
+
+    # reassemble the sharded rows
+    got = np.zeros((CP_B, CP_H, CP_W, CP_LEVELS * (2 * CP_RADIUS + 1) ** 2),
+                   np.float32)
+    seen = 0
+    for out in outs:
+        with np.load(out) as z:
+            for r0, rows in z.items():
+                got[:, int(r0):int(r0) + rows.shape[1]] = rows
+                seen += rows.shape[1]
+    assert seen == CP_H
+
+    # unsharded reference on this process
+    import jax.numpy as jnp
+
+    from dexiraft_tpu.ops.corr import build_corr_pyramid, corr_lookup
+
+    f1, f2, coords = cp_full_inputs()
+    pyr = build_corr_pyramid(jnp.asarray(f1), jnp.asarray(f2),
+                             num_levels=CP_LEVELS, radius=CP_RADIUS)
+    want = np.asarray(corr_lookup(pyr, jnp.asarray(coords)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
 def test_grads_match_single_process(child_results):
