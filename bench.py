@@ -334,11 +334,20 @@ def main() -> None:
     diag = {f"allpairs_{k}": v for k, v in ap_diag.items()}
     candidates = [("allpairs", "transpose", allpairs_ips, allpairs_loop)]
     loop_by_corr = {"allpairs": allpairs_loop}
+    # the parent kills us at HARD_CAP_S with the record unprinted — if
+    # the sweep is running long (slow relay compiles), drop remaining
+    # secondary configs and get the JSON out with what we have
+    secondary_budget_s = float(os.environ.get("BENCH_SECONDARY_BUDGET_S",
+                                              HARD_CAP_S - 550))
     if on_tpu:  # secondary metrics; not worth CPU-fallback time
         for corr_impl, upconv, tag in (
                 ("local", "transpose", "local"),
                 ("local", "subpixel", "local_subpix"),
                 ("allpairs", "subpixel", "allpairs_subpix")):
+            if time.perf_counter() - _T0 > secondary_budget_s:
+                _log(f"[{tag}] skipped: over secondary budget "
+                     f"({secondary_budget_s:.0f}s)")
+                continue
             try:
                 with_loop = upconv == "transpose"
                 ips, loop, d = measure(corr_impl, upconv,
