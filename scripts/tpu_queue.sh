@@ -21,6 +21,13 @@ mkdir -p "${1:-/tmp/tpu_queue}"
 OUT=$(readlink -f "${1:-/tmp/tpu_queue}")
 cd "$(dirname "$0")/.."
 
+# persistent XLA compilation cache: the tunnel sometimes heals only in
+# short windows — compiles paid in one window must survive to the next
+# attempt (a cold full-geometry bench is ~15-20 min of mostly compile).
+# Harmless if the backend declines to serialize (soft cache miss).
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+
 probe() {
   # healthy means the REAL TPU backend answers — a CPU fallback must not
   # count, or the queued "on-chip" numbers would silently be CPU numbers
