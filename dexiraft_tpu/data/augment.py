@@ -68,13 +68,25 @@ class ColorJitter:
         if self.hue > 0:
             ops.append(("hue", rng.uniform(-self.hue, self.hue)))
 
+        # brightness/contrast blend each pixel against a SCALAR, so on
+        # uint8 input they are exact 256-entry lookup tables — cv2.LUT
+        # replaces two full-image float passes (the profiled hot spot of
+        # the whole host pipeline) at bit-identical output: the table is
+        # built with the same f32 multiply-add + truncating cast per
+        # possible value that _blend applies per pixel
+        ramp = np.arange(256, dtype=np.float32)
         for i in rng.permutation(len(ops)):
             name, f = ops[i]
             if name == "brightness":
-                img = self._blend(img, np.zeros_like(img, np.float32), f)
+                lut = np.clip(f * ramp, 0, 255).astype(np.uint8)
+                img = cv2.LUT(img, lut)
             elif name == "contrast":
-                gray_mean = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY).mean()
-                img = self._blend(img, np.float32(gray_mean), f)
+                # cv2.mean agrees with ndarray.mean to fp rounding and
+                # is far cheaper
+                gray_mean = cv2.mean(cv2.cvtColor(img, cv2.COLOR_RGB2GRAY))[0]
+                lut = np.clip(f * ramp + (1.0 - f) * np.float32(gray_mean),
+                              0, 255).astype(np.uint8)
+                img = cv2.LUT(img, lut)
             elif name == "saturation":
                 gray = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None]
                 img = self._blend(img, gray.astype(np.float32), f)
@@ -148,20 +160,22 @@ class FlowAugmentor:
         sx, sy = self._sample_scales(rng, ht, wd)
         extras = list(extras) if extras else []
 
+        # float32 multipliers: a python-list factor would promote the
+        # whole flow map to float64 (2x host memory traffic per pass)
         if rng.random() < self.spatial_aug_prob:
             img1 = _resize(img1, sx, sy)
             img2 = _resize(img2, sx, sy)
-            flow = _resize(flow, sx, sy) * [sx, sy]
+            flow = _resize(flow, sx, sy) * np.array([sx, sy], np.float32)
             extras = [_resize(e, sx, sy) for e in extras]
 
         if self.do_flip:
             if rng.random() < self.h_flip_prob:
                 img1, img2 = img1[:, ::-1], img2[:, ::-1]
-                flow = flow[:, ::-1] * [-1.0, 1.0]
+                flow = flow[:, ::-1] * np.array([-1.0, 1.0], np.float32)
                 extras = [e[:, ::-1] for e in extras]
             if rng.random() < self.v_flip_prob:
                 img1, img2 = img1[::-1], img2[::-1]
-                flow = flow[::-1] * [1.0, -1.0]
+                flow = flow[::-1] * np.array([1.0, -1.0], np.float32)
                 extras = [e[::-1] for e in extras]
 
         y0 = rng.integers(0, img1.shape[0] - self.crop_size[0])
@@ -228,6 +242,10 @@ class SparseFlowAugmentor:
 
         ht1 = int(round(ht * fy))
         wd1 = int(round(wd * fx))
+        # float64 kept deliberately (unlike the dense-path multipliers):
+        # np.round on these decides each vector's integer splat
+        # destination, and the reference computes them in float64 too —
+        # the temporaries are small (valid points only)
         coords1 = coords0 * [fx, fy]
         flow1 = flow0 * [fx, fy]
 
@@ -257,7 +275,9 @@ class SparseFlowAugmentor:
 
         if self.do_flip and rng.random() < self.h_flip_prob:
             img1, img2 = img1[:, ::-1], img2[:, ::-1]
-            flow = flow[:, ::-1] * [-1.0, 1.0]
+            # float32 multiplier (sign flip is exact in any dtype; a
+            # python list would promote the map to float64)
+            flow = flow[:, ::-1] * np.array([-1.0, 1.0], np.float32)
             valid = valid[:, ::-1]
             extras = [e[:, ::-1] for e in extras]
 

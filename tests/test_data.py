@@ -104,6 +104,41 @@ class TestAugmentors:
         assert af.shape == (96, 160, 2) and av.shape == (96, 160)
         assert set(np.unique(av)).issubset({0.0, 1.0})
 
+    def test_jitter_lut_matches_blend(self):
+        # brightness/contrast run through cv2.LUT for speed; the
+        # PRODUCTION ColorJitter must reproduce the float-blend
+        # formulation (torchvision semantics: f32 multiply-add, clip,
+        # truncating uint8 cast) bit-for-bit. The expected side replays
+        # the jitter's own RNG draws through an independent blend-based
+        # reference.
+        import cv2
+
+        from dexiraft_tpu.data.augment import ColorJitter
+
+        def blend(img, other, f):
+            out = f * img.astype(np.float32) + (1.0 - f) * other
+            return np.clip(out, 0, 255).astype(np.uint8)
+
+        base = np.random.default_rng(3).integers(
+            0, 256, (64, 64, 3), dtype=np.uint8)
+        for seed in range(20):
+            # brightness-only: one op, factor replayed from the same seed
+            cj = ColorJitter(brightness=0.4)
+            got = cj(np.random.default_rng(seed), base)
+            r = np.random.default_rng(seed)
+            f = r.uniform(0.6, 1.4)
+            np.testing.assert_array_equal(
+                got, blend(base, np.float32(0.0), f))
+
+            # contrast-only
+            cj = ColorJitter(contrast=0.4)
+            got = cj(np.random.default_rng(seed), base)
+            r = np.random.default_rng(seed)
+            f = r.uniform(0.6, 1.4)
+            gm = cv2.cvtColor(base, cv2.COLOR_RGB2GRAY).mean()
+            np.testing.assert_array_equal(
+                got, blend(base, np.float32(gm), f))
+
     def test_hue_jitter_no_uint8_wrap(self):
         from dexiraft_tpu.data.augment import ColorJitter
 
