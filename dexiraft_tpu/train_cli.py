@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--val_freq", type=int, default=5000)
     p.add_argument("--sum_freq", type=int, default=100)
     p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--worker_mode", choices=["thread", "process"],
+                   default="thread",
+                   help="decode pool kind; 'process' sidesteps the GIL "
+                   "on many-core hosts (spawned, not forked: the CLI "
+                   "initializes jax before the loader exists)")
     p.add_argument("--log_dir", default="runs")
     p.add_argument("--profile_steps", type=int, nargs=2, default=None,
                    metavar=("START", "STOP"),
@@ -209,6 +214,7 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     print(f"Training with {len(dataset)} image pairs")
     loader = Loader(
         dataset, tc.batch_size, seed=tc.seed, num_workers=args.num_workers,
+        worker_mode=args.worker_mode, mp_start_method="spawn",
         process_index=jax.process_index(), process_count=jax.process_count())
 
     step_fn = make_train_step(cfg, tc, mesh=mesh)
