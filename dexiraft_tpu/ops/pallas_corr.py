@@ -37,7 +37,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dexiraft_tpu.ops.local_corr import local_corr_level
 
+# queries per grid step; read through _pixel_block() so on-chip tuning
+# (scripts/tpu_smoke.py sweeps DEXIRAFT_PALLAS_PIXEL_BLOCK) needs no
+# code edit. Resolved at trace time — rebuild the jit to change it.
 _PIXEL_BLOCK = 256
+
+
+def _pixel_block() -> int:
+    import os
+
+    # clamp: a bad flag must fail soft, not as a ZeroDivisionError deep
+    # inside jit tracing
+    return max(1, int(os.environ.get("DEXIRAFT_PALLAS_PIXEL_BLOCK",
+                                     _PIXEL_BLOCK)))
 
 
 def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
@@ -107,8 +119,9 @@ def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
                   ((0, 0), (pad, pad), (pad, pad), (0, 0)))
 
     # flatten pixels, pad to the block size
+    pixel_block = _pixel_block()
     n = h * w
-    n_pad = (-n) % _PIXEL_BLOCK
+    n_pad = (-n) % pixel_block
     np_tot = n + n_pad
     flat = lambda a, d: jnp.pad(a.reshape(b, n, *a.shape[3:]),
                                 ((0, 0), (0, n_pad)) + ((0, 0),) * d)
@@ -117,29 +130,29 @@ def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
     sy_flat = flat(sy, 0)
     frac_flat = flat(frac, 1)
 
-    grid = (b, np_tot // _PIXEL_BLOCK)
+    grid = (b, np_tot // pixel_block)
     kernel = functools.partial(_corr_kernel, radius=r, h2=h2, w2=w2)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _PIXEL_BLOCK), lambda bi, ti: (bi, ti),
+            pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, _PIXEL_BLOCK), lambda bi, ti: (bi, ti),
+            pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, _PIXEL_BLOCK, c), lambda bi, ti: (bi, ti, 0),
+            pl.BlockSpec((1, pixel_block, c), lambda bi, ti: (bi, ti, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h2 + 2 * pad, w2 + 2 * pad, c),
                          lambda bi, ti: (bi, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _PIXEL_BLOCK, 2), lambda bi, ti: (bi, ti, 0),
+            pl.BlockSpec((1, pixel_block, 2), lambda bi, ti: (bi, ti, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, _PIXEL_BLOCK, win * win),
+        out_specs=pl.BlockSpec((1, pixel_block, win * win),
                                lambda bi, ti: (bi, ti, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, np_tot, win * win), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((_PIXEL_BLOCK, k * k), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((pixel_block, k * k), jnp.float32)],
         interpret=interpret,
     )(sx_flat, sy_flat, f1_flat, f2p, frac_flat)
 

@@ -51,19 +51,60 @@ def stage_pallas() -> None:
     np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
 
-    reps = 10
-    t0 = time.perf_counter()
-    fn = jax.jit(lambda a, b_, c_: pallas_local_corr_level(a, b_, c_, 4))
-    for _ in range(reps):
-        jax.block_until_ready(fn(f1, f2, coords))
-    dt_p = (time.perf_counter() - t0) / reps
-    fn2 = jax.jit(lambda a, b_, c_: local_corr_level(a, b_, c_, 4, row_chunk=8))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        jax.block_until_ready(fn2(f1, f2, coords))
-    dt_x = (time.perf_counter() - t0) / reps
-    print(f"PALLAS PARITY OK  pallas {dt_p * 1e3:.2f} ms vs "
-          f"xla-gather {dt_x * 1e3:.2f} ms per level-0 lookup")
+    # timing via scalar fetch: block_until_ready does not reliably block
+    # through the relay tunnel (verify SKILL.md), so reduce to one value
+    # on device and float() it — and subtract the adjacent RTT floor
+    import os
+
+    trivial = jax.jit(lambda x: jnp.sum(x))
+    float(trivial(jnp.ones((8, 8))))
+
+    def rtt(n=4):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            float(trivial(jnp.ones((8, 8))))
+        return (time.perf_counter() - t0) / n
+
+    def timed(fn, reps=10):
+        float(fn(f1, f2, coords))  # compile + warm
+        floor = rtt()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            float(fn(f1, f2, coords))
+        dt = (time.perf_counter() - t0) / reps
+        if dt <= floor:
+            # an RTT spike during the floor sample would otherwise
+            # publish a ~0 ms nonsense win — report uncorrected instead
+            print(f"  WARNING: dt {dt * 1e3:.2f} ms <= rtt floor "
+                  f"{floor * 1e3:.2f} ms; reporting uncorrected")
+            return dt
+        return dt - floor
+
+    results = {}
+    for blk in (128, 256, 512):
+        os.environ["DEXIRAFT_PALLAS_PIXEL_BLOCK"] = str(blk)
+        # parity FIRST at this block size — Mosaic layout bugs are
+        # block-size-dependent, so a timing may only count for a block
+        # whose values were checked on this very chip
+        out_blk = jax.jit(
+            lambda a, b_, c_: pallas_local_corr_level(a, b_, c_, 4))(
+                f1, f2, coords)
+        np.testing.assert_allclose(np.asarray(out_blk), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+        fn = jax.jit(lambda a, b_, c_: jnp.sum(
+            pallas_local_corr_level(a, b_, c_, 4)))
+        results[blk] = timed(fn)
+        print(f"  pallas pixel_block={blk}: {results[blk] * 1e3:.2f} ms "
+              f"(parity ok)")
+    os.environ.pop("DEXIRAFT_PALLAS_PIXEL_BLOCK", None)
+    dt_p = min(results.values())
+    best = min(results, key=results.get)
+    fn2 = jax.jit(lambda a, b_, c_: jnp.sum(
+        local_corr_level(a, b_, c_, 4, row_chunk=8)))
+    dt_x = timed(fn2)
+    print(f"PALLAS PARITY OK  pallas {dt_p * 1e3:.2f} ms "
+          f"(best pixel_block={best}) vs xla-formulation {dt_x * 1e3:.2f} ms "
+          f"per level-0 lookup")
 
 
 def stage_train() -> None:

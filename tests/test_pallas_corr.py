@@ -86,3 +86,14 @@ def test_custom_vjp_grads():
     np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gr[1]),
                                rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(np.asarray(gp[2]), 0.0)
+
+
+def test_pixel_block_override_identical(monkeypatch):
+    # the tuning knob (DEXIRAFT_PALLAS_PIXEL_BLOCK, swept on-chip by
+    # tpu_smoke) must only change the grid partition, never the values
+    monkeypatch.delenv("DEXIRAFT_PALLAS_PIXEL_BLOCK", raising=False)
+    f1, f2, coords = _setup(jax.random.PRNGKey(2))
+    ref = pallas_local_corr_level(f1, f2, coords, 4, True)
+    monkeypatch.setenv("DEXIRAFT_PALLAS_PIXEL_BLOCK", "64")
+    out = pallas_local_corr_level(f1, f2, coords, 4, True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
