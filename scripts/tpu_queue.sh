@@ -55,6 +55,9 @@ wait_for_tunnel
 
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
+  # the completeness sweep below derives the job list from the run()
+  # calls themselves — one source of truth, nothing to keep in sync
+  JOBS_SEEN="$JOBS_SEEN $name"
   if [ -f "$OUT/$name.done" ]; then
     echo "$(date -u +%H:%M:%S) skip $name (done)" >> "$OUT/queue.log"
     return
@@ -86,24 +89,46 @@ run() {  # run <name> <timeout_s> <cmd...>
   sleep 30  # let the claim settle between holders
 }
 
-# 1. the official metric, hardened JSON (VERDICT next-1). 3000s outer
-#    timeout > bench's own HARD_CAP_S (1950) + CPU-fallback time, so the
-#    watchdogged parent, not this timeout, is what ends a stuck run
-run bench_record  3000 python bench.py
-# 2. the prelude profile + upconv A/B that decides the headline fix
-#    (VERDICT next-2: where do 104 ms go at a 4 ms MXU floor?)
-run prelude_profile 2700 python scripts/prelude_profile.py
-# 3. component-level forward numbers for docs/perf.md
-run micro_bench   1500 python scripts/micro_bench.py
-# 4. Pallas kernel compiled on real hardware: parity + timing (next-5)
-run tpu_smoke     1800 python scripts/tpu_smoke.py
-# 4. flagship v5 training throughput at chairs geometry (next-3)
-run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
-run train_remat   3000 python scripts/train_bench.py --variant v5 --batch 6 --remat
-# 5. memory-story probes (next-4)
-run highres       2400 python scripts/highres_probe.py --iters 8
-run warmstart     2400 python scripts/warmstart_bench.py --frames 8
-# 6. convergence transcripts: flagship v5 (next-3 stretch) + DexiNed
-run v5_demo       4200 python scripts/train_demo.py --variant v5 --steps 400 --batch 2 --size 192 256 --pool 8
-run dexined_demo  2400 python scripts/dexined_demo.py --steps 300
-echo "$(date -u +%H:%M:%S) queue complete" >> "$OUT/queue.log"
+run_all() {
+  # 1. the official metric, hardened JSON (VERDICT next-1). 3000s outer
+  #    timeout > bench's own HARD_CAP_S (1950) + CPU-fallback time, so
+  #    the watchdogged parent, not this timeout, ends a stuck run
+  run bench_record  3000 python bench.py
+  # 2. the prelude profile + upconv A/B that decides the headline fix
+  #    (VERDICT next-2: where do 104 ms go at a 4 ms MXU floor?)
+  run prelude_profile 2700 python scripts/prelude_profile.py
+  # 3. component-level forward numbers for docs/perf.md
+  run micro_bench   1500 python scripts/micro_bench.py
+  # 4. Pallas kernel compiled on real hardware: parity + block-size
+  #    sweep timing (next-5)
+  run tpu_smoke     1800 python scripts/tpu_smoke.py
+  # 5. flagship v5 training throughput at chairs geometry (next-3)
+  run train_remat_lookup 3000 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
+  run train_remat   3000 python scripts/train_bench.py --variant v5 --batch 6 --remat
+  # 6. memory-story probes (next-4)
+  run highres       2400 python scripts/highres_probe.py --iters 8
+  run warmstart     2400 python scripts/warmstart_bench.py --frames 8
+  # 7. convergence transcripts: flagship v5 (next-3 stretch) + DexiNed
+  run v5_demo       4200 python scripts/train_demo.py --variant v5 --steps 400 --batch 2 --size 192 256 --pool 8
+  run dexined_demo  2400 python scripts/dexined_demo.py --steps 300
+}
+
+# a mid-list tunnel death fails the remaining jobs; don't declare the
+# queue complete with holes — sweep the list again (run() skips .done
+# jobs) until everything landed or the retry budget is spent
+for attempt in 1 2 3; do
+  JOBS_SEEN=""
+  run_all
+  missing=""
+  for j in $JOBS_SEEN; do
+    [ -f "$OUT/$j.done" ] || missing="$missing $j"
+  done
+  if [ -z "$missing" ]; then
+    echo "$(date -u +%H:%M:%S) queue complete (attempt $attempt)" >> "$OUT/queue.log"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) attempt $attempt missing:$missing" >> "$OUT/queue.log"
+  sleep 120
+done
+echo "$(date -u +%H:%M:%S) queue gave up; missing:$missing" >> "$OUT/queue.log"
+exit 1

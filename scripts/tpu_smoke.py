@@ -40,16 +40,12 @@ def stage_pallas() -> None:
     coords = (jnp.stack([xs, ys], -1)[None]
               + jax.random.uniform(k3, (b, h, w, 2), jnp.float32, -3, 3))
 
-    t0 = time.perf_counter()
-    out_pallas = jax.block_until_ready(
-        jax.jit(lambda a, b_, c_: pallas_local_corr_level(a, b_, c_, 4))(
-            f1, f2, coords))
-    print(f"pallas compile+run: {time.perf_counter() - t0:.1f}s")
+    # XLA-formulation reference; the Pallas kernel's first compile and
+    # parity check happen inside the block-size sweep below (no
+    # duplicate Mosaic compile — cold compiles dominate queue cost)
     ref = jax.block_until_ready(
         jax.jit(lambda a, b_, c_: local_corr_level(a, b_, c_, 4, row_chunk=8))(
             f1, f2, coords))
-    np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(ref),
-                               rtol=2e-3, atol=2e-3)
 
     # timing via scalar fetch: block_until_ready does not reliably block
     # through the relay tunnel (verify SKILL.md), so reduce to one value
