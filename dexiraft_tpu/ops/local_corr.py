@@ -118,9 +118,14 @@ class LocalCorr:
             coords_i = coords / (2.0 ** i)
             if self.use_pallas:
                 from dexiraft_tpu.ops.pallas_corr import pallas_local_corr_level
+
+                # interpret=None defers to the kernel module's
+                # DEXIRAFT_PALLAS_INTERPRET env knob, which makes this
+                # whole-model path exercisable off-chip
+                # (tests/test_local_corr.py)
                 corr = pallas_local_corr_level(
                     self.fmap1, f2, coords_i, self.radius,
-                    False, self.row_chunk)
+                    None, self.row_chunk)
             else:
                 corr = local_corr_level(
                     self.fmap1, f2, coords_i, self.radius, self.row_chunk)

@@ -52,6 +52,16 @@ def _pixel_block() -> int:
                                      _PIXEL_BLOCK)))
 
 
+def _interpret_default() -> bool:
+    # DEXIRAFT_PALLAS_INTERPRET=1 runs the kernel in interpreter mode
+    # (trace-time switch) — lets the whole-model corr_impl="pallas" path
+    # run off-chip (tests/test_local_corr.py). Never set it on a TPU
+    # host: the interpreter is orders of magnitude slower.
+    import os
+
+    return os.environ.get("DEXIRAFT_PALLAS_INTERPRET", "0") == "1"
+
+
 def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
                  lattice_ref, *, radius: int, h2: int, w2: int):
     r = radius
@@ -96,7 +106,9 @@ def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
 
 
 def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
-                    radius: int, interpret: bool = False) -> jax.Array:
+                    radius: int, interpret=None) -> jax.Array:
+    if interpret is None:
+        interpret = _interpret_default()
     b, h, w, c = fmap1.shape
     h2, w2 = fmap2.shape[1:3]
     r = radius
@@ -161,12 +173,14 @@ def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def pallas_local_corr_level(fmap1, fmap2, coords, radius: int,
-                            interpret: bool = False, row_chunk=8):
+                            interpret=None, row_chunk=8):
     """(B,H,W,C) x (B,H2,W2,C) x (B,H,W,2 level coords) -> (B,H,W,(2r+1)^2).
 
-    row_chunk only affects the backward recompute (the forward kernel is
-    already pixel-blocked); pass the model's corr_row_chunk so the VJP's
-    transient patch buffer honors the same bound.
+    interpret=None defers to DEXIRAFT_PALLAS_INTERPRET (off-chip debug
+    switch, resolved at trace time). row_chunk only affects the backward
+    recompute (the forward kernel is already pixel-blocked); pass the
+    model's corr_row_chunk so the VJP's transient patch buffer honors
+    the same bound.
     """
     return _pallas_forward(fmap1, fmap2, coords, radius, interpret)
 
