@@ -49,8 +49,13 @@ class ColorJitter:
 
     @staticmethod
     def _blend(img: np.ndarray, other: np.ndarray, factor: float) -> np.ndarray:
-        out = factor * img.astype(np.float32) + (1.0 - factor) * other
-        return np.clip(out, 0, 255).astype(np.uint8)
+        # in-place over one f32 buffer (same f32 math, value-identical;
+        # the naive expression allocates three full-image temporaries)
+        out = img.astype(np.float32)
+        out *= factor
+        out += (1.0 - factor) * other
+        np.clip(out, 0, 255, out=out)
+        return out.astype(np.uint8)
 
     def __call__(self, rng: np.random.Generator, img: np.ndarray) -> np.ndarray:
         import cv2
