@@ -17,9 +17,18 @@ import jax.numpy as jnp
 _EPS = 1e-10
 
 
-def _bce_sum(probs: jax.Array, targets: jax.Array, weights: jax.Array) -> jax.Array:
-    p = jnp.clip(probs, _EPS, 1.0 - _EPS)
-    ce = -(targets * jnp.log(p) + (1.0 - targets) * jnp.log(1.0 - p))
+def _bce_logits_sum(logits: jax.Array, targets: jax.Array,
+                    weights: jax.Array) -> jax.Array:
+    """Weighted BCE in LOGITS space: softplus(x) - t*x.
+
+    Exactly -t*log(p) - (1-t)*log(1-p) for p = sigmoid(x), but stable
+    at saturation. The clipped-probability form NaN'd in fp32: the
+    upper clip bound 1 - 1e-10 rounds to exactly 1.0 (fp32 eps ~1.2e-7),
+    so a saturated-positive logit at a positive pixel produced
+    (1-t)*log(1-p) = 0 * (-inf) = NaN — observed live at step ~316 of
+    the CPU DexiNed demo. The torch reference survives the same regime
+    because F.binary_cross_entropy clamps its logs at -100 internally."""
+    ce = jax.nn.softplus(logits) - targets * logits
     return jnp.sum(weights * ce)
 
 
@@ -38,7 +47,7 @@ def bdcn_loss2(logits: jax.Array, targets: jax.Array,
     num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
     total = num_pos + num_neg
     w = jnp.where(pos > 0, num_neg / total, 1.1 * num_pos / total)
-    return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
+    return l_weight * _bce_logits_sum(logits, t, w)
 
 
 def hed_loss2(logits: jax.Array, targets: jax.Array,
@@ -51,7 +60,7 @@ def hed_loss2(logits: jax.Array, targets: jax.Array,
     num_neg = jnp.sum((t <= 0.0).astype(jnp.float32))
     total = num_pos + num_neg
     w = jnp.where(pos > 0, num_neg / total, 1.1 * num_pos / total)
-    return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
+    return l_weight * _bce_logits_sum(logits, t, w)
 
 
 def bdcn_loss_ori(logits: jax.Array, targets: jax.Array,
@@ -69,7 +78,7 @@ def bdcn_loss_ori(logits: jax.Array, targets: jax.Array,
     num_neg = jnp.sum(neg, axis=axes, keepdims=True)
     valid = jnp.maximum(num_pos + num_neg, 1.0)
     w = pos * (num_neg / valid) + neg * (1.1 * num_pos / valid)
-    return l_weight * _bce_sum(jax.nn.sigmoid(logits), t, w)
+    return l_weight * _bce_logits_sum(logits, t, w)
 
 
 def rcf_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -83,7 +92,7 @@ def rcf_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     total = num_pos + num_neg
     w = jnp.where(pos, num_neg / total,
                   jnp.where(neg, 1.1 * num_pos / total, 0.0))
-    return _bce_sum(jax.nn.sigmoid(logits), jnp.where(pos, 1.0, 0.0), w)
+    return _bce_logits_sum(logits, jnp.where(pos, 1.0, 0.0), w)
 
 
 def _box_sum(x: jax.Array, radius: int) -> jax.Array:
@@ -136,7 +145,7 @@ def cats_loss(logits: jax.Array, targets: jax.Array,
     mask = jnp.where(t == 1.0, beta,
                      jnp.where(t == 0.0, balanced_w * (1.0 - beta), 0.0))
     prediction = jax.nn.sigmoid(logits)
-    cost = _bce_sum(prediction, t, mask)
+    cost = _bce_logits_sum(logits, t, mask)
 
     label_w = (t != 0.0).astype(jnp.float32)
     return (cost

@@ -150,13 +150,13 @@ def main():
         return jnp.mean(jnp.linalg.norm(flow_up - batch["flow"], axis=-1))
 
     t0 = time.perf_counter()
-    state, metrics = step_fn(state, pool[0])
-    float(metrics["loss"])
-    log(f"# compile+first step {time.perf_counter() - t0:.1f}s")
-    t0 = time.perf_counter()
     heldout = float(val_epe(state.params, state.batch_stats, val_batch))
     log(f"# probe compile+eval {time.perf_counter() - t0:.1f}s "
         f"(untrained heldout_epe {heldout:.3f})")
+    t0 = time.perf_counter()
+    state, metrics = step_fn(state, pool[0])
+    float(metrics["loss"])
+    log(f"# compile+first step {time.perf_counter() - t0:.1f}s")
 
     # the probe evals run inside the loop but are excluded from the
     # steps/s denominator — the printed rate stays a TRAINING

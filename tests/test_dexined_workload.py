@@ -193,3 +193,49 @@ def test_cli_test_pich_channel_swap(biped_tree, tmp_path, monkeypatch):
         assert os.path.exists(path), path
         img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
         assert img.shape == (64, 64)
+
+
+class TestSaturationStability:
+    def test_bce_losses_finite_and_differentiable_at_saturation(self):
+        # regression: the clipped-probability BCE NaN'd in fp32 once a
+        # POSITIVE pixel's logit saturated (upper clip bound 1 - 1e-10
+        # rounds to 1.0 in fp32, so (1-t)*log(1-p) = 0 * -inf = NaN) —
+        # observed live at step ~316 of the CPU DexiNed demo. The
+        # logits-space form must stay finite in value AND gradient for
+        # arbitrarily large logits of either sign.
+        import jax
+
+        from dexiraft_tpu.dexined.losses import (
+            bdcn_loss2,
+            bdcn_loss_ori,
+            cats_loss,
+            hed_loss2,
+            rcf_loss,
+        )
+
+        logits = jnp.array([[[[200.0], [-200.0]], [[75.0], [0.3]]]])
+        targets = jnp.array([[[[1.0], [0.0]], [[0.0], [1.0]]]])
+        for fn in (bdcn_loss2, hed_loss2, bdcn_loss_ori, rcf_loss,
+                   lambda x, t: cats_loss(x, t, (0.1, 0.1))):
+            val, grad = jax.value_and_grad(lambda x: fn(x, targets))(logits)
+            assert np.isfinite(float(val)), fn
+            assert np.isfinite(np.asarray(grad)).all(), fn
+
+    def test_logits_bce_matches_clipped_form_unsaturated(self):
+        # in the unsaturated regime the stable form equals the clipped
+        # -t log p - (1-t) log(1-p) it replaced
+        from dexiraft_tpu.dexined.losses import bdcn_loss2
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(0, 3, (1, 8, 8, 1)).astype(np.float32))
+        targets = jnp.asarray((rng.random((1, 8, 8, 1)) > 0.8)
+                              .astype(np.float32))
+        got = float(bdcn_loss2(logits, targets))
+        p = np.clip(1.0 / (1.0 + np.exp(-np.asarray(logits, np.float64))),
+                    1e-10, 1 - 1e-10)
+        t = np.asarray(targets, np.float64)
+        num_pos = t.sum()
+        num_neg = t.size - num_pos
+        w = np.where(t > 0, num_neg / t.size, 1.1 * num_pos / t.size)
+        want = 1.1 * np.sum(w * -(t * np.log(p) + (1 - t) * np.log(1 - p)))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
