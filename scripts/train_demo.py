@@ -83,6 +83,13 @@ def main():
                     help="force the CPU backend (the axon site hook "
                          "re-pins JAX_PLATFORMS, so the env var alone "
                          "does not stick; config.update does)")
+    ap.add_argument("--ckpt_dir", default=None,
+                    help="checkpoint every --ckpt_every steps and resume "
+                         "from the latest step on restart — a multi-hour "
+                         "CPU transcript must survive session kills "
+                         "(train/checkpoint.py round-trips opt state + "
+                         "step, so OneCycle continues, not restarts)")
+    ap.add_argument("--ckpt_every", type=int, default=25)
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -100,8 +107,16 @@ def main():
         if args.variant != "small" else f"train_demo_{platform}.log")
     import os
 
+    start_step = 0
+    if args.ckpt_dir:
+        from dexiraft_tpu.train.checkpoint import latest_step
+
+        if osp.isdir(args.ckpt_dir):
+            start_step = latest_step(args.ckpt_dir) or 0
+
     os.makedirs(osp.dirname(log_path), exist_ok=True)
-    log_f = open(log_path, "w")
+    # resuming appends: the transcript stays one continuous record
+    log_f = open(log_path, "a" if start_step else "w")
 
     def log(msg):
         print(msg)
@@ -149,21 +164,31 @@ def main():
             train=False, test_mode=True)
         return jnp.mean(jnp.linalg.norm(flow_up - batch["flow"], axis=-1))
 
-    t0 = time.perf_counter()
-    heldout = float(val_epe(state.params, state.batch_stats, val_batch))
-    log(f"# probe compile+eval {time.perf_counter() - t0:.1f}s "
-        f"(untrained heldout_epe {heldout:.3f})")
-    t0 = time.perf_counter()
-    state, metrics = step_fn(state, pool[0])
-    float(metrics["loss"])
-    log(f"# compile+first step {time.perf_counter() - t0:.1f}s")
+    if start_step:
+        from dexiraft_tpu.train.checkpoint import restore_checkpoint
+
+        state = restore_checkpoint(args.ckpt_dir, state, step=start_step)
+        log(f"# resumed from {args.ckpt_dir} at step {start_step} "
+            f"(opt state + OneCycle step restored)")
+        loop_from = start_step + 1
+    else:
+        t0 = time.perf_counter()
+        heldout = float(val_epe(state.params, state.batch_stats, val_batch))
+        log(f"# probe compile+eval {time.perf_counter() - t0:.1f}s "
+            f"(untrained heldout_epe {heldout:.3f})")
+        t0 = time.perf_counter()
+        state, metrics = step_fn(state, pool[0])
+        float(metrics["loss"])
+        log(f"# compile+first step {time.perf_counter() - t0:.1f}s")
+        loop_from = 1
 
     # the probe evals run inside the loop but are excluded from the
     # steps/s denominator — the printed rate stays a TRAINING
     # throughput, comparable with earlier transcripts of this script
     t0 = time.perf_counter()
     eval_s = 0.0
-    for i in range(1, args.steps):
+    heldout = None
+    for i in range(loop_from, args.steps):
         state, metrics = step_fn(state, pool[i % args.pool])
         if i % 25 == 0 or i == args.steps - 1:
             # drain the async train stream FIRST (the loss fetch is the
@@ -176,11 +201,20 @@ def main():
             heldout = float(val_epe(state.params, state.batch_stats,
                                     val_batch))
             eval_s += time.perf_counter() - te
+            # rate over steps run in THIS process — on resume, dividing
+            # the global index by post-restart elapsed would inflate it
             log(f"[{i:5d}] loss {loss_v:7.3f}  "
                 f"epe {epe_v:6.3f}  "
                 f"heldout_epe {heldout:6.3f}  "
-                f"{i / train_elapsed:5.2f} steps/s")
+                f"{(i - loop_from + 1) / train_elapsed:5.2f} steps/s")
+        if args.ckpt_dir and (i % args.ckpt_every == 0
+                              or i == args.steps - 1):
+            from dexiraft_tpu.train.checkpoint import save_checkpoint
 
+            save_checkpoint(args.ckpt_dir, state, step=i)
+
+    if heldout is None:  # resumed at/after the last step: loop was empty
+        heldout = float(val_epe(state.params, state.batch_stats, val_batch))
     mag = float(jnp.mean(jnp.linalg.norm(val_batch["flow"], axis=-1)))
     log(f"# held-out synthetic val: EPE {heldout:.3f} (mean |flow| {mag:.3f})")
     log_f.close()
