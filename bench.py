@@ -38,6 +38,28 @@ def _log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr)
 
 
+def _cpu_anchor_fields() -> dict:
+    """The measured torch-vs-flax same-CPU anchor, parsed from the
+    anchor script's log (one copy of the number: the measurement's)."""
+    import os.path as osp
+
+    path = osp.join(osp.dirname(osp.abspath(__file__)),
+                    "logs", "torch_cpu_anchor.log")
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.lstrip().startswith("{"):
+                    rec = json.loads(line)
+                    return {
+                        "cpu_anchor_flax_over_torch":
+                            rec["flax_over_torch"],
+                        "cpu_anchor_source": "logs/torch_cpu_anchor.log",
+                    }
+    except (OSError, ValueError, KeyError):
+        pass
+    return {}
+
+
 _T0 = time.perf_counter()
 
 
@@ -408,6 +430,12 @@ def main() -> None:
         # measured A100 number (none exists in the reference's record)
         "baseline_kind": "estimate",
         "baseline_iters_per_sec": BASELINE_ITERS_PER_SEC,
+        # measured same-silicon framework anchor: flax v5 forward vs the
+        # reference's torch v5 forward on this host's CPU, same process,
+        # same geometry (scripts/torch_cpu_anchor.py, docs/perf.md) —
+        # read from the measurement's own log so the record can never
+        # drift from its source; absent if the anchor was never run
+        **_cpu_anchor_fields(),
         "iters": iters,
         "corr_impl": impl,
         "dexined_upconv": upconv_best,

@@ -104,14 +104,19 @@ def read_flow_kitti(path: Union[str, os.PathLike]) -> Tuple[np.ndarray, np.ndarr
     return flow, valid
 
 
-def write_flow_kitti(path: Union[str, os.PathLike], flow: np.ndarray) -> None:
-    """(H, W, 2) flow -> KITTI 16-bit PNG (all pixels marked valid)."""
+def write_flow_kitti(path: Union[str, os.PathLike], flow: np.ndarray,
+                     valid: Optional[np.ndarray] = None) -> None:
+    """(H, W, 2) flow -> KITTI 16-bit PNG; ``valid`` (H, W) marks the
+    measured pixels (KITTI GT is sparse), default all-valid."""
     import cv2
 
     flow = np.asarray(flow, np.float32)
     enc = 64.0 * flow + 2**15
-    valid = np.ones((*flow.shape[:2], 1), np.float32)
-    out = np.concatenate([enc, valid], axis=-1).astype(np.uint16)
+    if valid is None:
+        valid = np.ones(flow.shape[:2], np.float32)
+    out = np.concatenate(
+        [enc, np.asarray(valid, np.float32)[..., None]],
+        axis=-1).astype(np.uint16)
     cv2.imwrite(os.fspath(path), out[:, :, ::-1])
 
 

@@ -1,0 +1,62 @@
+"""Build the reference torch stack (when its checkout is mounted).
+
+Interop tooling used by the parity tests and the measured-anchor script:
+constructs the reference's v5 RAFT (core/raft.py) with a random-init
+embedded DexiNed, working around two reference realities:
+
+  * RAFT.__init__ hard-loads a DexiNed checkpoint from a path that ships
+    outside the repo (core/raft.py:30-33) — torch.load is patched for
+    the duration of construction and fed a freshly initialized DexiNed
+    state dict instead;
+  * the reference modules import each other by bare name (``from raft
+    import RAFT`` etc.), so its directories go on sys.path temporarily.
+
+Nothing here imports at package-import time; call sites pay the torch
+import. Raises FileNotFoundError when the checkout is not mounted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os.path as osp
+import sys
+
+REF_ROOT = "/root/reference"
+REF_CORE = osp.join(REF_ROOT, "core")
+
+
+def _import_from(path: str, module: str):
+    sys.path.insert(0, path)
+    try:
+        return __import__(module)
+    finally:
+        sys.path.remove(path)
+
+
+def build_reference_v5(dexi_seed: int = 7):
+    """Reference v5 RAFT (eval mode) with seeded random DexiNed weights.
+
+    Returns the torch module. Deterministic for a given ``dexi_seed``
+    (the RAFT weights themselves come from torch.manual_seed state set
+    here too, so two calls with the same seed build identical models).
+    """
+    if not osp.isdir(REF_CORE):
+        raise FileNotFoundError(f"reference checkout not at {REF_CORE}")
+    import torch
+
+    TorchDexiNed = _import_from(
+        osp.join(REF_CORE, "DexiNed"), "model").DexiNed
+    torch.manual_seed(dexi_seed)
+    dexi_sd = TorchDexiNed().state_dict()
+
+    orig_load = torch.load
+    torch.load = lambda *a, **k: dexi_sd
+    try:
+        TorchRAFTv5 = _import_from(REF_CORE, "raft").RAFT
+        model = TorchRAFTv5(argparse.Namespace(
+            small=False, dropout=0.0, mixed_precision=False,
+            alternate_corr=False))
+    finally:
+        torch.load = orig_load
+    model.eval()
+    return model

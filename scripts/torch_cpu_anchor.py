@@ -1,0 +1,99 @@
+"""Measured baseline anchor: reference torch v5 vs our flax v5, same CPU.
+
+The 320 iters/s denominator in bench.py is an estimate (upstream RAFT's
+~10 FPS at 1088x436 on a 1080Ti x 32 iters) because the reference records
+no throughput numbers anywhere (BASELINE.md). No CUDA GPU exists in this
+environment, so the reference's CUDA path cannot be timed — but its torch
+code CAN be timed on this host's CPU against our stack at identical
+geometry, in the same process, under the same load. That ratio is a
+measured, like-for-like anchor for "how does the framework compare to the
+reference on the same silicon" — it complements (not replaces) the
+on-chip vs-estimate headline.
+
+Workload: v5 test-mode forward, iters as given (default 6 to match
+bench.py's CPU fallback), geometry 224x512 (same). Reference classes are
+imported from /root/reference verbatim; the embedded DexiNed checkpoint
+load is fed a random state dict (no checkpoints ship in the reference).
+
+Writes a JSON line; tee it into logs/torch_cpu_anchor.log and cite in
+docs/perf.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os.path as osp
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--height", type=int, default=224)
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+    h, w, iters = args.height, args.width, args.iters
+
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    rng = np.random.default_rng(0)
+    im1 = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+    im2 = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+
+    # ---- reference torch path ----
+    from dexiraft_tpu.interop.reference import build_reference_v5
+
+    tm = build_reference_v5()
+    t1 = torch.from_numpy(im1.transpose(0, 3, 1, 2))
+    t2 = torch.from_numpy(im2.transpose(0, 3, 1, 2))
+    with torch.no_grad():
+        tm(t1, t2, iters=iters, test_mode=True)  # warm (autotune etc.)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            tm(t1, t2, iters=iters, test_mode=True)
+        torch_s = (time.perf_counter() - t0) / args.reps
+    print(f"[anchor] torch forward {torch_s * 1e3:.0f} ms", file=sys.stderr)
+
+    # ---- our path, same process/load ----
+    from dexiraft_tpu.config import raft_v5
+    from dexiraft_tpu.models.raft import RAFT
+
+    cfg = raft_v5(mixed_precision=False)
+    model = RAFT(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, h, w, 3)), jnp.zeros((1, h, w, 3)),
+                           iters=1, train=False)
+    fwd = jax.jit(lambda v, a, b: model.apply(
+        v, a, b, iters=iters, train=False, test_mode=True))
+    j1, j2 = jnp.asarray(im1), jnp.asarray(im2)
+    jax.block_until_ready(fwd(variables, j1, j2))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        jax.block_until_ready(fwd(variables, j1, j2))
+    jax_s = (time.perf_counter() - t0) / args.reps
+    print(f"[anchor] flax forward {jax_s * 1e3:.0f} ms", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"cpu_anchor_v5_forward@{h}x{w}x{iters}it",
+        "torch_ms": round(torch_s * 1e3, 1),
+        "flax_ms": round(jax_s * 1e3, 1),
+        "torch_iters_per_sec": round(iters / torch_s, 3),
+        "flax_iters_per_sec": round(iters / jax_s, 3),
+        "flax_over_torch": round(torch_s / jax_s, 3),
+        "host": "2-core CPU (build container)",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,24 @@ def test_pyramid_shapes_floor_division():
     assert shapes == [(55, 13), (27, 6), (13, 3), (6, 1)]
 
 
+def test_lookup_finite_at_one_pixel_levels():
+    """A pyramid level that collapses to a single row/col must still
+    produce finite lookups. The reference's bilinear_sampler normalizes
+    grid coords by (dim-1) (core/utils/utils.py:63-66), so a 1-pixel
+    level divides by zero and floods the update block with nan (observed
+    in tests/test_eval_stack_parity.py at 104x136 inputs). Our one-hot
+    interpolation matmul uses absolute coords and stays finite at every
+    size — small-image inference just works."""
+    from dexiraft_tpu.ops import coords_grid
+
+    rng = np.random.RandomState(3)
+    f = rng.randn(1, 13, 17, 8).astype(np.float32)  # 104x136 at 1/8
+    pyr = build_corr_pyramid(f, f, num_levels=4, radius=4)
+    assert pyr.levels[-1].shape[1:3] == (1, 2)  # degenerate level hit
+    out = corr_lookup(pyr, coords_grid(1, 13, 17))
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_corr_pyramid_is_jit_safe_pytree():
     """Geometry ints are static aux data — jit/scan must not trace them."""
     import jax
