@@ -275,3 +275,66 @@ def test_validate_kitti_matches_reference(tmp_path, monkeypatch, v5_pair):
     # F1 is a percentage of outlier pixels — threshold-crossing flips
     # move it in quanta of 100/n_valid; allow a handful of pixels
     assert ref["kitti-f1"] == pytest.approx(ours["kitti-f1"], abs=0.5)
+
+
+def _write_hd1k_tree(root, rng):
+    """Synthetic HD1K layout, one sequence of 3 frames with sparse GT."""
+    from PIL import Image
+
+    from dexiraft_tpu.data.flow_io import write_flow_kitti
+
+    kh, kw = 124, 196  # same corr-level-safe geometry as the KITTI tree
+    img_dir = os.path.join(root, "hd1k_input", "image_2")
+    flow_dir = os.path.join(root, "hd1k_flow_gt", "flow_occ")
+    os.makedirs(img_dir, exist_ok=True)
+    os.makedirs(flow_dir, exist_ok=True)
+    for i in range(3):
+        img = rng.integers(0, 256, (kh, kw, 3), dtype=np.uint8)
+        Image.fromarray(img).save(
+            os.path.join(img_dir, f"000000_{i:04d}.png"))
+        coarse = rng.uniform(-4, 4, (5, 7, 2)).astype(np.float32)
+        flow = np.kron(coarse, np.ones((26, 28, 1), np.float32))[:kh, :kw]
+        flow = np.round(flow * 64.0) / 64.0
+        valid = (rng.random((kh, kw)) < 0.7).astype(np.float32)
+        write_flow_kitti(os.path.join(flow_dir, f"000000_{i:04d}.png"),
+                         flow, valid)
+
+
+@pytest.mark.slow
+def test_validate_hd1k_reference_crashes_ours_scores(tmp_path, monkeypatch,
+                                                     v5_pair):
+    """The reference's validate_HD1K is unrunnable as written: it
+    unpacks the valid mask into `_` and then reads `valid_gt`
+    (evaluate.py:182,197) — NameError on the first sample. Pinning the
+    crash documents that our validate_hd1k (which uses the mask) is a
+    bug fix, not a divergence; there is no reference number to match."""
+    import jax.numpy as jnp
+
+    from dexiraft_tpu.data.datasets import HD1K
+    from dexiraft_tpu.eval.validate import validate_hd1k
+    from dexiraft_tpu.train.step import make_eval_step
+
+    root = str(tmp_path / "HD1k")
+    _write_hd1k_tree(root, np.random.default_rng(11))
+
+    tm, cfg, variables = v5_pair
+
+    ref_evaluate = _import_ref_evaluate()
+    monkeypatch.setattr(torch.Tensor, "cuda",
+                        lambda self, *a, **k: self)
+    ref_hd1k_init = ref_evaluate.datasets.HD1K.__init__
+    defaults = list(ref_hd1k_init.__defaults__)
+    defaults[-1] = root  # (aug_params, root)
+    monkeypatch.setattr(ref_hd1k_init, "__defaults__", tuple(defaults))
+    with torch.no_grad(), pytest.raises(NameError):
+        ref_evaluate.validate_HD1K(tm, iters=2)
+
+    step = make_eval_step(cfg, iters=2)
+
+    def eval_fn(i1, i2):
+        lo, up = step(variables, jnp.asarray(i1), jnp.asarray(i2))
+        return np.asarray(lo), np.asarray(up)
+
+    ours = validate_hd1k(eval_fn, dataset=HD1K(None, root=root))
+    assert np.isfinite(ours["hd1k-epe"])
+    assert 0.0 <= ours["hd1k-f1"] <= 100.0
