@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--steps_per_epoch", type=int, default=None,
                    help="cap batches per epoch (default: full dataset)")
+    p.add_argument("--no_guard", action="store_true",
+                   help="disable the epoch-end divergence guard "
+                        "(non-finite loss -> roll back to the last "
+                        "epoch checkpoint instead of saving the "
+                        "poisoned state; see train_cli for rationale)")
+    p.add_argument("--max_rollbacks", type=int, default=3)
     p.add_argument("--gt_root", default=None,
                    help="ground-truth edge-map dir: --test additionally "
                         "reports ODS/OIS/AP (dexined.metrics)")
@@ -162,6 +168,11 @@ def train(args) -> None:
 
     n = len(dataset)
     steps_per_epoch = args.steps_per_epoch or max(n // args.batch_size, 1)
+    rollbacks = 0
+    # only checkpoints written by THIS run are valid rollback targets —
+    # --checkpoint defaults to a constant dir, and splicing a previous
+    # experiment's weights into this one would be silent corruption
+    last_saved = None
     for epoch in range(args.epochs):
         # periodic reseed like the reference's per-epoch reshuffle
         # (main.py:403-410)
@@ -184,7 +195,28 @@ def train(args) -> None:
         state = TrainState(step=jnp.int32((epoch + 1) * steps_per_epoch),
                            params=params, batch_stats=batch_stats,
                            opt_state=opt_state, rng=rng)
+        # epoch-end divergence guard: once params go non-finite every
+        # later loss is nan too, so the last-batch loss is a sufficient
+        # poison detector — never let a poisoned epoch reach disk
+        if not args.no_guard and not np.isfinite(float(loss)):
+            if last_saved is None or rollbacks >= args.max_rollbacks:
+                raise RuntimeError(
+                    f"DexiNed training diverged (loss {float(loss)}) in "
+                    f"epoch {epoch}"
+                    + (" before this run saved any checkpoint"
+                       if last_saved is None
+                       else f" after {rollbacks} rollbacks"))
+            rollbacks += 1
+            prev = ckpt_io.restore_checkpoint(args.checkpoint, state,
+                                              step=last_saved)
+            params, batch_stats, opt_state = (
+                prev.params, prev.batch_stats, prev.opt_state)
+            print(f"[guard] non-finite loss in epoch {epoch}; restored "
+                  f"step {last_saved} "
+                  f"(rollback {rollbacks}/{args.max_rollbacks})")
+            continue
         ckpt_io.save_checkpoint(args.checkpoint, state)
+        last_saved = int(state.step)
         print(f"Epoch {epoch}: checkpoint -> {args.checkpoint}")
 
 

@@ -75,6 +75,15 @@ class Logger:
         self._steps_since = 0
         self._t0 = time.perf_counter()
 
+    def rewind(self, step: int) -> None:
+        """Align with a trainer rollback: drop the (possibly poisoned)
+        accumulation window and rewind the step counter so subsequent
+        emitted/checkpointed/validated step numbers agree again."""
+        self.total_steps = step
+        self.running = {}
+        self._steps_since = 0
+        self._t0 = time.perf_counter()
+
     def write_dict(self, results: Dict[str, float], step: Optional[int] = None) -> None:
         """Validation results (train.py:126-131)."""
         self._write(results, self.total_steps if step is None else step)

@@ -104,6 +104,25 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar=("START", "STOP"),
                    help="capture a jax.profiler trace for steps "
                         "[START, STOP) into <log_dir>/<name>/profile")
+    # failure detection / elastic recovery — absent in the reference
+    # (SURVEY.md §5): its v3 run diverged from EPE 8.4 to 347 and kept
+    # logging (logs/raft_3_train_chairs_log*.out), and outages killed
+    # runs that were restarted by hand. Here a non-finite or exploding
+    # loss rolls the full state back to the last checkpoint and training
+    # continues on the data stream's current position (the divergent
+    # batch window is naturally skipped, not replayed).
+    p.add_argument("--no_guard", action="store_true",
+                   help="disable the divergence guard")
+    p.add_argument("--guard_every", type=int, default=100,
+                   help="check the loss every N steps (a host sync; the "
+                        "logger already syncs at --sum_freq, so matching "
+                        "it costs nothing extra)")
+    p.add_argument("--guard_threshold", type=float, default=1e4,
+                   help="loss above this (or non-finite) triggers a "
+                        "rollback to the last checkpoint")
+    p.add_argument("--max_rollbacks", type=int, default=3,
+                   help="abort after this many rollbacks (persistent "
+                        "divergence needs a human: lower the lr)")
     return p
 
 
@@ -198,8 +217,13 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     state = create_state(jax.random.PRNGKey(tc.seed), cfg, tc)
     print(f"Parameter Count: {param_count(state.params)}")
 
+    # last checkpoint that belongs to THIS trajectory — the only valid
+    # rollback target. A stale dir from a previous experiment must never
+    # be spliced into a fresh run by the guard.
+    last_saved = None
     if args.resume and ckpt.latest_step(ckpt_dir) is not None:
         state = ckpt.restore_checkpoint(ckpt_dir, state)
+        last_saved = ckpt.latest_step(ckpt_dir)
         print(f"Resumed full state at step {int(state.step)}")
     elif args.restore_ckpt:
         prev = ckpt.restore_checkpoint(args.restore_ckpt, state)
@@ -228,6 +252,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     prof_active = False
 
     total_steps = int(state.step)
+    rollbacks = 0
+    metrics = None
     with mesh:
         for batch in loader:
             # range-based (not equality) so resumed runs landing inside
@@ -244,8 +270,38 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                 prof_active = False
                 print(f"[profile] trace -> {prof_dir}")
 
+            # divergence guard: checked on its own cadence AND before
+            # every checkpoint write, so a poisoned state is never saved
+            if not args.no_guard and (
+                    total_steps % args.guard_every == 0
+                    or total_steps % tc.val_freq == 0):
+                loss_v = float(jax.device_get(metrics["loss"]))
+                if not np.isfinite(loss_v) or loss_v > args.guard_threshold:
+                    if last_saved is None or rollbacks >= args.max_rollbacks:
+                        raise RuntimeError(
+                            f"training diverged (loss {loss_v:.4g}) at "
+                            f"step {total_steps}"
+                            + (" before this run saved any checkpoint"
+                               if last_saved is None else
+                               f" after {rollbacks} rollbacks")
+                            + "; lower the lr or inspect the data")
+                    rollbacks += 1
+                    state = ckpt.restore_checkpoint(ckpt_dir, state,
+                                                    step=last_saved)
+                    print(f"[guard] loss {loss_v:.4g} at step "
+                          f"{total_steps}; restored step {last_saved} "
+                          f"(rollback {rollbacks}/{args.max_rollbacks})")
+                    # relative rewind: the logger's counter is per-run
+                    # (starts at 0 on resume), so subtract the rolled-
+                    # back window rather than assigning the global step
+                    logger.rewind(logger.total_steps
+                                  - (total_steps - last_saved))
+                    total_steps = last_saved
+                    continue  # never checkpoint on a rollback step
+
             if total_steps % tc.val_freq == 0:
                 ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
+                last_saved = total_steps
                 for vname in tc.validation:
                     logger.write_dict(validate(vname), step=total_steps)
             if total_steps >= tc.num_steps:
@@ -254,7 +310,19 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     if prof_active:  # window extended past the last step: finalize
         jax.profiler.stop_trace()
         print(f"[profile] trace (truncated at end of run) -> {prof_dir}")
-    ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
+    # the final save honors the guard too: a nan that arrives between
+    # guard checks and the end of the run must not become the latest
+    # checkpoint that --resume/eval would silently load
+    final_ok = True
+    if not args.no_guard and metrics is not None:
+        loss_v = float(jax.device_get(metrics["loss"]))
+        if not np.isfinite(loss_v) or loss_v > args.guard_threshold:
+            final_ok = False
+            print(f"[guard] final state poisoned (loss {loss_v:.4g}); "
+                  f"skipping the final save — latest good checkpoint "
+                  f"remains step {last_saved}")
+    if final_ok:
+        ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
     logger.close()
     print(f"Done: {total_steps} steps -> {ckpt_dir}")
 

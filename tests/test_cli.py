@@ -71,6 +71,47 @@ def test_train_resume_eval_roundtrip(chairs_env):
     assert up.shape == (1, 64, 64, 2)
 
 
+def test_divergence_guard_rolls_back_then_aborts(chairs_env):
+    """Elastic-recovery guard (absent in the reference, SURVEY.md §5:
+    its v3 diverged and kept logging). Poison the dataset after a good
+    checkpoint exists: the guard must roll back to it — never saving a
+    poisoned state — retry up to --max_rollbacks, then abort loudly."""
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train_cli import main as train_main
+
+    tmp = chairs_env
+    train_main(_train_args(tmp, 2))
+    ckpt_dir = str(tmp / "ckpts" / "t")
+    assert ckpt.latest_step(ckpt_dir) == 2
+
+    # poison every flow file -> every batch from here on yields nan loss
+    data = tmp / "FlyingChairs_release" / "data"
+    for f in data.glob("*_flow.flo"):
+        write_flo(f, np.full((96, 128, 2), np.nan, np.float32))
+
+    with pytest.raises(RuntimeError, match="diverged.*after 2 rollbacks"):
+        train_main(_train_args(
+            tmp, 6, extra=["--resume", "--guard_every", "1",
+                           "--max_rollbacks", "2"]))
+    # the poisoned steps never reached disk
+    assert ckpt.latest_step(ckpt_dir) == 2
+
+
+def test_guard_disabled_reproduces_reference_behavior(chairs_env):
+    """--no_guard: nan losses train through to completion (what the
+    reference always did) — the guard is an opt-out upgrade, not a
+    behavior change for anyone who wants the old semantics."""
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train_cli import main as train_main
+
+    tmp = chairs_env
+    data = tmp / "FlyingChairs_release" / "data"
+    for f in data.glob("*_flow.flo"):
+        write_flo(f, np.full((96, 128, 2), np.nan, np.float32))
+    train_main(_train_args(tmp, 2, extra=["--no_guard"]))
+    assert ckpt.latest_step(str(tmp / "ckpts" / "t")) == 2
+
+
 def test_eval_cli_edgesum_dispatch(chairs_env, capsys):
     """--dataset edgesum wires through the validator registry: the CLI
     builds the edge-pair chairs-val dataset from --edge_root and

@@ -135,6 +135,74 @@ class TestEdgeDatasets:
         assert s["image_shape"] == (100, 210)
 
 
+def test_dexined_guard_rolls_back_then_aborts(biped_tree, tmp_path,
+                                              monkeypatch):
+    """Epoch-end divergence guard, single run: epoch 0 trains clean and
+    checkpoints; a save hook then poisons the data (nan images -> nan
+    loss), so epoch 1 rolls back to epoch 0's checkpoint and epoch 2
+    exhausts the retry budget. The poisoned epochs never reach disk."""
+    import dexiraft_tpu.dexined_cli as cli
+    from dexiraft_tpu.dexined.data import BipedDataset
+    from dexiraft_tpu.train import checkpoint as ckpt_io
+
+    monkeypatch.chdir(tmp_path)
+    ckpt = str(tmp_path / "ck")
+    base = ["--train", "--data_root", str(biped_tree), "--batch_size", "2",
+            "--img_size", "64", "--lr", "1e-4", "--steps_per_epoch", "2",
+            "--checkpoint", ckpt]
+
+    poisoned = {"on": False}
+    orig_save = ckpt_io.save_checkpoint
+
+    def save_then_poison(*a, **k):
+        orig_save(*a, **k)
+        poisoned["on"] = True
+
+    monkeypatch.setattr(ckpt_io, "save_checkpoint", save_then_poison)
+    orig_sample = BipedDataset.sample
+
+    def sample(self, i, rng=None):
+        s = orig_sample(self, i, rng)
+        if poisoned["on"]:
+            s = dict(s, images=np.full_like(s["images"], np.nan))
+        return s
+
+    monkeypatch.setattr(BipedDataset, "sample", sample)
+
+    with pytest.raises(RuntimeError, match="diverged.*after 1 rollbacks"):
+        cli.main(base + ["--epochs", "4", "--max_rollbacks", "1"])
+    assert ckpt_io.latest_step(ckpt) == 2  # epoch 0 (2 steps); no poison
+
+
+def test_dexined_guard_refuses_stale_checkpoints(biped_tree, tmp_path,
+                                                 monkeypatch):
+    """A fresh run that diverges before ITS OWN first checkpoint must
+    abort — not silently splice in a previous experiment's weights that
+    happen to live in the (default-constant) checkpoint dir."""
+    import dexiraft_tpu.dexined_cli as cli
+    from dexiraft_tpu.dexined.data import BipedDataset
+    from dexiraft_tpu.train import checkpoint as ckpt_io
+
+    monkeypatch.chdir(tmp_path)
+    ckpt = str(tmp_path / "ck2")
+    base = ["--train", "--data_root", str(biped_tree), "--batch_size", "2",
+            "--img_size", "64", "--lr", "1e-4", "--steps_per_epoch", "2",
+            "--checkpoint", ckpt]
+    cli.main(base + ["--epochs", "1"])  # the "previous experiment"
+    assert ckpt_io.latest_step(ckpt) is not None
+
+    orig_sample = BipedDataset.sample
+    monkeypatch.setattr(
+        BipedDataset, "sample",
+        lambda self, i, rng=None: dict(
+            orig_sample(self, i, rng),
+            images=np.full_like(orig_sample(self, i, rng)["images"],
+                                np.nan)))
+    with pytest.raises(RuntimeError,
+                       match="before this run saved any checkpoint"):
+        cli.main(base + ["--epochs", "2"])
+
+
 def test_cli_train_then_test(biped_tree, tmp_path, monkeypatch):
     import cv2
 
