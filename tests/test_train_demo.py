@@ -63,3 +63,28 @@ def test_make_batch_shapes_and_dtypes():
     assert b["flow"].shape == (2, 32, 48, 2)
     assert b["valid"].shape == (2, 32, 48)
     assert str(b["image1"].dtype) == "float32"
+
+
+def test_checkpoint_resume_continues_run(tmp_path, monkeypatch, capsys):
+    """--ckpt_dir resume: a killed demo run must continue from its last
+    checkpoint (full state, so the OneCycle schedule continues too) and
+    append to the same transcript — this protects the multi-hour v5 CPU
+    insurance transcript from session kills."""
+    import train_demo
+
+    log = str(tmp_path / "t.log")
+    ck = str(tmp_path / "ck")
+    base = ["train_demo.py", "--cpu", "--variant", "small", "--batch", "1",
+            "--size", "64", "64", "--pool", "2", "--ckpt_dir", ck,
+            "--ckpt_every", "2", "--log", log]
+    monkeypatch.setattr(sys, "argv", base + ["--steps", "4"])
+    train_demo.main()
+    first = open(log).read()
+    assert "[    3]" in first  # completed the declared run
+
+    monkeypatch.setattr(sys, "argv", base + ["--steps", "6"])
+    train_demo.main()
+    full = open(log).read()
+    assert full.startswith(first)  # appended, not rewritten
+    assert "# resumed from" in full
+    assert "[    5]" in full
