@@ -277,6 +277,59 @@ def test_validate_kitti_matches_reference(tmp_path, monkeypatch, v5_pair):
     assert ref["kitti-f1"] == pytest.approx(ours["kitti-f1"], abs=0.5)
 
 
+@pytest.mark.slow
+def test_sintel_submission_reference_crashes_ours_writes(tmp_path,
+                                                        monkeypatch,
+                                                        v5_pair):
+    """The reference's create_sintel_submission is unrunnable as
+    written: it builds the TRAINING split, whose samples are 4-tuples
+    (image1, image2, flow, valid), but unpacks three values
+    (evaluate.py:26,33) — ValueError on the first sample. Pin that
+    crash, then write the submission tree from the same synthetic data
+    with our writer (same warm-start protocol, on-device splat)."""
+    import jax.numpy as jnp
+
+    from dexiraft_tpu.data.datasets import MpiSintel
+    from dexiraft_tpu.eval.submission import create_sintel_submission
+    from dexiraft_tpu.train.step import make_eval_step
+
+    root = str(tmp_path / "Sintel")
+    _write_sintel_tree(root, np.random.default_rng(21))
+
+    tm, cfg, variables = v5_pair
+
+    ref_evaluate = _import_ref_evaluate()
+    monkeypatch.setattr(torch.Tensor, "cuda",
+                        lambda self, *a, **k: self)
+    ref_sintel_init = ref_evaluate.datasets.MpiSintel.__init__
+    defaults = list(ref_sintel_init.__defaults__)
+    defaults[-2] = root
+    monkeypatch.setattr(ref_sintel_init, "__defaults__", tuple(defaults))
+    with torch.no_grad(), pytest.raises(ValueError):
+        ref_evaluate.create_sintel_submission(
+            tm, iters=2, output_path=str(tmp_path / "ref_sub"))
+
+    step = make_eval_step(cfg, iters=2)
+
+    def eval_fn(i1, i2, flow_init=None):
+        lo, up = step(variables, jnp.asarray(i1), jnp.asarray(i2),
+                      flow_init=None if flow_init is None
+                      else jnp.asarray(flow_init))
+        return np.asarray(lo), np.asarray(up)
+
+    out = tmp_path / "sub"
+    create_sintel_submission(
+        eval_fn, output_path=str(out), warm_start=True,
+        datasets={"clean": MpiSintel(None, split="training", root=root,
+                                     dstype="clean", qualitative=True)})
+    written = sorted(p.relative_to(out).as_posix()
+                     for p in out.rglob("*.flo"))
+    assert written == ["clean/alley_9/frame0001.flo",
+                       "clean/alley_9/frame0002.flo",
+                       "clean/market_9/frame0001.flo",
+                       "clean/market_9/frame0002.flo"]
+
+
 def _write_hd1k_tree(root, rng):
     """Synthetic HD1K layout, one sequence of 3 frames with sparse GT."""
     from PIL import Image
