@@ -354,6 +354,62 @@ def _write_hd1k_tree(root, rng):
 
 
 @pytest.mark.slow
+def test_kitti_submission_reference_crashes_ours_writes(tmp_path,
+                                                       monkeypatch,
+                                                       v5_pair):
+    """create_kitti_submission shares the 3-of-4 unpack crash (it also
+    writes .flo files where the KITTI devkit expects 16-bit PNGs —
+    evaluate.py:58-77). Pin the crash; our writer emits the PNGs on the
+    proper testing split and they decode back finite."""
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from dexiraft_tpu.data.datasets import KITTI
+    from dexiraft_tpu.data.flow_io import read_flow_kitti
+    from dexiraft_tpu.eval.submission import create_kitti_submission
+    from dexiraft_tpu.train.step import make_eval_step
+
+    root = str(tmp_path / "Kitti_2015")
+    rng = np.random.default_rng(9)
+    _write_kitti_tree(root, rng)  # training split, for the reference
+    test_img = os.path.join(root, "data_scene_flow", "testing", "image_2")
+    os.makedirs(test_img)
+    for i in range(2):
+        for suffix in ("10", "11"):
+            Image.fromarray(rng.integers(0, 256, (124, 196, 3),
+                                         dtype=np.uint8)).save(
+                os.path.join(test_img, f"{i:06d}_{suffix}.png"))
+
+    tm, cfg, variables = v5_pair
+    ref_evaluate = _import_ref_evaluate()
+    monkeypatch.setattr(torch.Tensor, "cuda",
+                        lambda self, *a, **k: self)
+    ref_kitti_init = ref_evaluate.datasets.KITTI.__init__
+    defaults = list(ref_kitti_init.__defaults__)
+    defaults[-1] = root
+    monkeypatch.setattr(ref_kitti_init, "__defaults__", tuple(defaults))
+    with torch.no_grad(), pytest.raises(ValueError):
+        ref_evaluate.create_kitti_submission(
+            tm, iters=2, output_path=str(tmp_path / "ref_sub"))
+
+    step = make_eval_step(cfg, iters=2)
+
+    def eval_fn(i1, i2):
+        lo, up = step(variables, jnp.asarray(i1), jnp.asarray(i2))
+        return np.asarray(lo), np.asarray(up)
+
+    out = tmp_path / "sub"
+    create_kitti_submission(
+        eval_fn, output_path=str(out),
+        dataset=KITTI(None, split="testing", root=root))
+    pngs = sorted(p.name for p in out.glob("*.png"))
+    assert pngs == ["000000_10.png", "000001_10.png"]
+    flow, valid = read_flow_kitti(out / "000000_10.png")
+    assert flow.shape == (124, 196, 2) and np.isfinite(flow).all()
+    assert (valid == 1).all()
+
+
+@pytest.mark.slow
 def test_validate_hd1k_reference_crashes_ours_scores(tmp_path, monkeypatch,
                                                      v5_pair):
     """The reference's validate_HD1K is unrunnable as written: it
