@@ -51,3 +51,43 @@ def test_sequence_loss_matches_reference(gamma):
     np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
     for k in ref_metrics:
         np.testing.assert_allclose(float(metrics[k]), ref_metrics[k], rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_loss_matches_reference_source():
+    """Same parity, but against the reference's ACTUAL train.sequence_loss
+    imported from the checkout (train.py:48-73) — the transcription above
+    could drift; the source of truth cannot."""
+    import os.path as osp
+    import sys
+
+    if not osp.isdir("/root/reference/core"):
+        pytest.skip("reference checkout not mounted")
+    import test_eval_stack_parity as parity
+
+    parity._import_ref_evaluate()  # stubs torchvision, loads siblings
+    for p in ("/root/reference", "/root/reference/core"):
+        sys.path.insert(0, p)
+    try:
+        import train as ref_train
+    finally:
+        for p in ("/root/reference", "/root/reference/core"):
+            sys.path.remove(p)
+
+    rng = np.random.RandomState(7)
+    iters, b, h, w = 4, 2, 8, 10
+    preds = rng.randn(iters, b, h, w, 2).astype(np.float32) * 3
+    gt = rng.randn(b, h, w, 2).astype(np.float32) * 3
+    valid = (rng.rand(b, h, w) > 0.3).astype(np.float32)
+    gt[0, 0, 0] = [500.0, 0.0]  # hits the MAX_FLOW magnitude mask
+
+    loss, metrics = sequence_loss(preds, gt, valid)
+
+    t_preds = [torch.from_numpy(p.transpose(0, 3, 1, 2)) for p in preds]
+    ref_loss, ref_metrics = ref_train.sequence_loss(
+        t_preds, torch.from_numpy(gt.transpose(0, 3, 1, 2)),
+        torch.from_numpy(valid))
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in ref_metrics:
+        np.testing.assert_allclose(float(metrics[k]), float(ref_metrics[k]),
+                                   rtol=1e-4, atol=1e-5)
