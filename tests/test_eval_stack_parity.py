@@ -278,6 +278,65 @@ def test_validate_kitti_matches_reference(tmp_path, monkeypatch, v5_pair):
 
 
 @pytest.mark.slow
+def test_validate_chairs_matches_reference(tmp_path, monkeypatch, v5_pair):
+    """Fourth validator: FlyingChairs val EPE (evaluate.py:79-98 — the
+    one remaining runnable reference eval path; no padder, raw-size
+    forward). Same synthetic tree, same converted weights, pinned
+    equal. chairs_split.txt is read cwd-relative by the reference
+    (core/datasets.py:131), so the test chdirs into the fixture."""
+    import imageio.v2 as imageio
+
+    import jax.numpy as jnp
+
+    from dexiraft_tpu.data.datasets import FlyingChairs
+    from dexiraft_tpu.data.flow_io import write_flo
+    from dexiraft_tpu.eval.validate import validate_chairs
+    from dexiraft_tpu.train.step import make_eval_step
+
+    ch, cw = 128, 160  # /8 exact (the reference path never pads) and
+    # large enough that no corr level degenerates (16x20 at 1/8)
+    data = tmp_path / "FlyingChairs_release" / "data"
+    data.mkdir(parents=True)
+    rng = np.random.default_rng(13)
+    n = 4
+    for i in range(n):
+        for k in (1, 2):
+            imageio.imwrite(
+                data / f"{i:05d}_img{k}.ppm",
+                rng.integers(0, 256, (ch, cw, 3), dtype=np.uint8))
+        coarse = rng.uniform(-4, 4, (5, 7, 2)).astype(np.float32)
+        write_flo(data / f"{i:05d}_flow.flo",
+                  np.kron(coarse, np.ones((26, 24, 1),
+                                          np.float32))[:ch, :cw])
+    # 3 of 4 pairs land in the validation split (label 2)
+    (tmp_path / "chairs_split.txt").write_text("2\n2\n1\n2\n")
+
+    tm, cfg, variables = v5_pair
+
+    ref_evaluate = _import_ref_evaluate()
+    monkeypatch.setattr(torch.Tensor, "cuda",
+                        lambda self, *a, **k: self)
+    ref_chairs_init = ref_evaluate.datasets.FlyingChairs.__init__
+    defaults = list(ref_chairs_init.__defaults__)
+    defaults[-1] = str(data)  # (aug_params, split, root)
+    monkeypatch.setattr(ref_chairs_init, "__defaults__", tuple(defaults))
+    monkeypatch.chdir(tmp_path)  # chairs_split.txt lookup
+    with torch.no_grad():
+        ref = ref_evaluate.validate_chairs(tm, iters=ITERS)
+
+    step = make_eval_step(cfg, iters=ITERS)
+
+    def eval_fn(i1, i2):
+        lo, up = step(variables, jnp.asarray(i1), jnp.asarray(i2))
+        return np.asarray(lo), np.asarray(up)
+
+    ours = validate_chairs(eval_fn, dataset=FlyingChairs(
+        None, split="validation", root=str(data)))
+    np.testing.assert_allclose(ours["chairs"], ref["chairs"],
+                               rtol=5e-3, atol=5e-3, err_msg="Chairs EPE")
+
+
+@pytest.mark.slow
 def test_sintel_submission_reference_crashes_ours_writes(tmp_path,
                                                         monkeypatch,
                                                         v5_pair):
