@@ -32,7 +32,12 @@ def main():
     ap.add_argument("--chunk", type=int, default=8,
                     help="query-row chunk for the on-demand path")
     ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the axon site hook "
+                         "pins JAX_PLATFORMS; config.update overrides)")
     args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     h, w = args.size
     assert h % 16 == 0 and w % 16 == 0
 
