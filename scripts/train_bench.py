@@ -30,7 +30,13 @@ def main():
     ap.add_argument("--size", type=int, nargs=2, default=(368, 496))
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--remat_lookup", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (shakeout while the "
+                         "tunnel is down; config.update beats the "
+                         "axon site-hook pin)")
     args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from dexiraft_tpu import config as C
     from dexiraft_tpu.config import TrainConfig
