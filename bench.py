@@ -45,19 +45,27 @@ def _cpu_anchor_fields() -> dict:
 
     path = osp.join(osp.dirname(osp.abspath(__file__)),
                     "logs", "torch_cpu_anchor.log")
+    fields: dict = {}
     try:
         with open(path) as f:
+            # the anchor script APPENDS on re-runs: keep the LAST
+            # parseable record so the bench carries the freshest
+            # measurement, not the oldest
             for line in f:
-                if line.lstrip().startswith("{"):
+                if not line.lstrip().startswith("{"):
+                    continue
+                try:
                     rec = json.loads(line)
-                    return {
+                    fields = {
                         "cpu_anchor_flax_over_torch":
                             rec["flax_over_torch"],
                         "cpu_anchor_source": "logs/torch_cpu_anchor.log",
                     }
-    except (OSError, ValueError, KeyError):
+                except (ValueError, KeyError):
+                    continue
+    except OSError:
         pass
-    return {}
+    return fields
 
 
 _T0 = time.perf_counter()
@@ -436,16 +444,18 @@ def main() -> None:
         # read from the measurement's own log so the record can never
         # drift from its source; absent if the anchor was never run
         **_cpu_anchor_fields(),
-        # best-known ON-CHIP state, carried so a fallback record is
-        # self-describing rather than reading as a 400x regression:
+        # best-known ON-CHIP state, carried ONLY on fallback records so
+        # they self-describe rather than read as a 400x regression —
         # round-1 builder-session measurements at this exact workload,
         # honestly labeled as not yet reproduced by a driver-captured
-        # run (docs/perf.md has the methodology)
-        "builder_tpu_reference": {
+        # run (docs/perf.md has the methodology). A genuine platform=tpu
+        # record must carry its own measured numbers, never these
+        # hand-copied constants beside (possibly contradicting) them.
+        **({"builder_tpu_reference": {
             "forward_ms": 183.1,
             "loop_only_iters_per_sec": 389.9,
             "provenance": "builder session r1, unconfirmed by driver",
-        },
+        }} if not on_tpu else {}),
         "iters": iters,
         "corr_impl": impl,
         "dexined_upconv": upconv_best,

@@ -27,6 +27,7 @@ import numpy as np
 from dexiraft_tpu.dexined.data import DATASET_INFO, BipedDataset, TestDataset
 from dexiraft_tpu.dexined.losses import weighted_multiscale_loss
 from dexiraft_tpu.models.dexined import DexiNed
+from dexiraft_tpu.train import step as step_lib
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -162,13 +163,21 @@ def train(args) -> None:
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_stats, opt_state, loss
+        # post-update verdict: the loss certifies the PRE-update params
+        # only; the epoch checkpoint saves THIS state (see train.step)
+        ok = step_lib.all_finite(params, new_stats, opt_state)
+        return params, new_stats, opt_state, loss, ok
 
     from dexiraft_tpu.train.state import TrainState
 
+    from dexiraft_tpu.train.guard import DivergenceGuard
+
     n = len(dataset)
     steps_per_epoch = args.steps_per_epoch or max(n // args.batch_size, 1)
-    rollbacks = 0
+    # finiteness-only: healthy BDCN multiscale losses run in the
+    # thousands (logs/dexined_demo_cpu.log), so no magnitude threshold
+    guard = DivergenceGuard(threshold=float("inf"),
+                            max_rollbacks=args.max_rollbacks)
     # only checkpoints written by THIS run are valid rollback targets —
     # --checkpoint defaults to a constant dir, and splicing a previous
     # experiment's weights into this one would be silent corruption
@@ -186,7 +195,7 @@ def train(args) -> None:
                 (args.seed, epoch, int(i)))) for i in ids]
             images = np.stack([s["images"] for s in samples])
             labels = np.stack([s["labels"] for s in samples])
-            params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, loss, state_ok = step(
                 params, batch_stats, opt_state, images, labels)
             if b % 5 == 0:
                 print(f"{time.ctime()} Epoch: {epoch} Sample {b}/"
@@ -195,25 +204,22 @@ def train(args) -> None:
         state = TrainState(step=jnp.int32((epoch + 1) * steps_per_epoch),
                            params=params, batch_stats=batch_stats,
                            opt_state=opt_state, rng=rng)
-        # epoch-end divergence guard: once params go non-finite every
-        # later loss is nan too, so the last-batch loss is a sufficient
-        # poison detector — never let a poisoned epoch reach disk
-        if not args.no_guard and not np.isfinite(float(loss)):
-            if last_saved is None or rollbacks >= args.max_rollbacks:
-                raise RuntimeError(
-                    f"DexiNed training diverged (loss {float(loss)}) in "
-                    f"epoch {epoch}"
-                    + (" before this run saved any checkpoint"
-                       if last_saved is None
-                       else f" after {rollbacks} rollbacks"))
-            rollbacks += 1
+        # epoch-end divergence guard: the last-batch loss catches poison
+        # introduced BEFORE that batch's update; state_ok (computed on
+        # the post-update state inside the step) catches the final
+        # batch's own update poisoning the state the save would persist
+        if not args.no_guard and guard.poisoned(float(loss),
+                                                bool(state_ok)):
+            guard.consume_rollback(float(loss), bool(state_ok),
+                                   f"epoch {epoch}", last_saved)
             prev = ckpt_io.restore_checkpoint(args.checkpoint, state,
                                               step=last_saved)
             params, batch_stats, opt_state = (
                 prev.params, prev.batch_stats, prev.opt_state)
-            print(f"[guard] non-finite loss in epoch {epoch}; restored "
+            print(f"[guard] poisoned epoch {epoch} (loss {float(loss):.4g}, "
+                  f"state_finite={bool(state_ok)}); restored "
                   f"step {last_saved} "
-                  f"(rollback {rollbacks}/{args.max_rollbacks})")
+                  f"(rollback {guard.rollbacks}/{args.max_rollbacks})")
             continue
         ckpt_io.save_checkpoint(args.checkpoint, state)
         last_saved = int(state.step)

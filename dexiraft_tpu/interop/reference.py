@@ -18,6 +18,7 @@ import. Raises FileNotFoundError when the checkout is not mounted.
 from __future__ import annotations
 
 import argparse
+import importlib
 import os.path as osp
 import sys
 
@@ -25,12 +26,42 @@ REF_ROOT = "/root/reference"
 REF_CORE = osp.join(REF_ROOT, "core")
 
 
+def _is_reference_module(mod) -> bool:
+    file = getattr(mod, "__file__", None)
+    if file and file.startswith(REF_ROOT):
+        return True
+    # namespace packages (e.g. 'DexiNed') carry no __file__, only paths
+    return any(str(p).startswith(REF_ROOT)
+               for p in getattr(mod, "__path__", ()))
+
+
 def _import_from(path: str, module: str):
+    """Import ``module`` from ``path`` without leaking the reference's
+    generically-named modules into sys.modules.
+
+    The reference imports its siblings by bare name ('model', 'raft',
+    'update', 'utils', ...). Left cached, a later unrelated ``import
+    model`` anywhere in the process would silently receive the
+    reference's — so after the import every sys.modules entry that
+    resolves into the reference tree is evicted (and any pre-existing
+    entry it shadowed is restored). The module objects we return stay
+    alive through the references we hold; their internal imports were
+    already resolved at import time.
+    """
+    before = dict(sys.modules)
     sys.path.insert(0, path)
     try:
-        return __import__(module)
+        return importlib.import_module(module)
     finally:
         sys.path.remove(path)
+        for name, mod in list(sys.modules.items()):
+            if name in before and mod is before[name]:
+                continue  # untouched pre-existing entry
+            if _is_reference_module(mod):
+                if name in before:
+                    sys.modules[name] = before[name]
+                else:
+                    del sys.modules[name]
 
 
 def build_reference_v5(dexi_seed: int = 7):
@@ -39,6 +70,10 @@ def build_reference_v5(dexi_seed: int = 7):
     Returns the torch module. Deterministic for a given ``dexi_seed``
     (the RAFT weights themselves come from torch.manual_seed state set
     here too, so two calls with the same seed build identical models).
+
+    NOT thread-safe: torch.load is patched process-globally for the
+    duration of construction (the reference hard-loads a checkpoint
+    path that ships outside its repo) — call from one thread only.
     """
     if not osp.isdir(REF_CORE):
         raise FileNotFoundError(f"reference checkout not at {REF_CORE}")

@@ -12,6 +12,7 @@ leaf whose path and shape match and leave the rest freshly initialized.
 
 from __future__ import annotations
 
+import atexit
 import os
 from typing import Any, Optional, Tuple
 
@@ -21,27 +22,53 @@ import orbax.checkpoint as ocp
 from dexiraft_tpu.train.state import TrainState
 
 
-def _manager(directory: str, max_to_keep: Optional[int] = None) -> ocp.CheckpointManager:
-    return ocp.CheckpointManager(
-        os.path.abspath(directory),
-        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
-    )
+_MANAGERS: "dict[str, ocp.CheckpointManager]" = {}
+
+
+def _manager(directory: str, refresh: bool = True) -> ocp.CheckpointManager:
+    """One live CheckpointManager per directory.
+
+    Constructing and closing a fresh manager per save/restore is fine at
+    VAL_FREQ=5000 but wasteful the moment the cadence tightens (each
+    construction lists the directory and spins up orbax's async save
+    machinery). Cached managers are reload()ed before READS so steps
+    written by another process are still observed; writers pass
+    refresh=False (a save needs no directory re-listing).
+    """
+    key = os.path.abspath(directory)
+    mgr = _MANAGERS.get(key)
+    if mgr is None:
+        mgr = ocp.CheckpointManager(
+            key, options=ocp.CheckpointManagerOptions(create=True))
+        _MANAGERS[key] = mgr
+    elif refresh and hasattr(mgr, "reload"):
+        mgr.reload()
+    return mgr
+
+
+@atexit.register
+def close_managers() -> None:
+    """Close every cached manager (flushes pending async work).
+
+    Registered atexit so long processes touching many directories (a
+    pytest run's tmp dirs) don't leak orbax's per-manager machinery
+    through interpreter shutdown; safe to call earlier by hand.
+    """
+    for mgr in _MANAGERS.values():
+        mgr.close()
+    _MANAGERS.clear()
 
 
 def save_checkpoint(directory: str, state: TrainState, step: Optional[int] = None) -> None:
     """Write <directory>/<step>/ with the full state (blocking)."""
-    mgr = _manager(directory)
+    mgr = _manager(directory, refresh=False)
     s = int(state.step) if step is None else int(step)
     mgr.save(s, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
-    mgr.close()
 
 
 def latest_step(directory: str) -> Optional[int]:
-    mgr = _manager(directory)
-    step = mgr.latest_step()
-    mgr.close()
-    return step
+    return _manager(directory).latest_step()
 
 
 def restore_checkpoint(
@@ -55,9 +82,7 @@ def restore_checkpoint(
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    mgr.close()
-    return restored
+    return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
 
 def restore_params_into(

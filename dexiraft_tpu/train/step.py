@@ -37,6 +37,26 @@ from dexiraft_tpu.train.state import TrainState, make_optimizer_from
 Batch = Dict[str, jax.Array]  # image1, image2, flow, valid [, edges1, edges2]
 
 
+def all_finite(*trees: Any) -> jax.Array:
+    """Scalar bool: every inexact leaf of every tree is finite.
+
+    The checkpoint gate's poison detector. The guard's loss check alone
+    has a one-step blind spot: value_and_grad computes the loss from the
+    PRE-update params, but the checkpoint saves the POST-update state —
+    a step whose update introduces non-finite values passes the loss
+    check and the poisoned state reaches disk. Emitting this signal from
+    the step itself (computed on the NEW state) closes that gap; it is
+    one elementwise pass over the state, noise next to the backward.
+    """
+    ok = jnp.bool_(True)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            leaf = jnp.asarray(leaf)
+            if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
 def _add_noise(rng: jax.Array, stdv: jax.Array, image: jax.Array) -> jax.Array:
     """Gaussian noise at the given stdv, clipped to [0,255] (train.py:170-173);
     the reference draws ONE stdv ~ U(0,5) shared by both frames."""
@@ -117,7 +137,9 @@ def make_train_step(
             opt_state=opt_state,
             rng=rng,
         )
-        metrics = dict(metrics, loss=loss, lr=schedule(state.step))
+        metrics = dict(metrics, loss=loss, lr=schedule(state.step),
+                       state_finite=all_finite(params, batch_stats,
+                                               opt_state))
         return new_state, metrics
 
     if mesh is None:

