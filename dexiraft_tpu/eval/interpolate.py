@@ -86,7 +86,9 @@ def forward_interpolate(flow: jax.Array) -> jax.Array:
     def dist2(state):
         return ((state[..., 0] - xsf) ** 2 + (state[..., 1] - ysf) ** 2)
 
-    best = seed
+    # carry each cell's CURRENT squared distance as a 5th channel so the
+    # compare below evaluates one dist2 per neighbor, not two
+    best = jnp.concatenate([seed, dist2(seed)[..., None]], axis=-1)
     for k in _jfa_steps(hs, ws):
         for dy in (-k, 0, k):
             for dx in (-k, 0, k):
@@ -101,11 +103,12 @@ def forward_interpolate(flow: jax.Array) -> jax.Array:
                 wrapped = ((src_y < 0) | (src_y >= hs)
                            | (src_x < 0) | (src_x >= ws))
                 cand = jnp.where(wrapped[..., None], FAR, cand)
-                best = jnp.where((dist2(cand) < dist2(best))[..., None],
+                cand = cand.at[..., 4].set(dist2(cand))
+                best = jnp.where((cand[..., 4] < best[..., 4])[..., None],
                                  cand, best)
 
     # output pixels sit at fine-grid nodes (s*i, s*j): stride-slice them
     best = best[::s, ::s]
     # no seed anywhere (every vector left the frame): reference fill=0
     found = best[..., 0] < FAR * 0.5
-    return jnp.where(found[..., None], best[..., 2:], 0.0)
+    return jnp.where(found[..., None], best[..., 2:4], 0.0)

@@ -18,6 +18,32 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
+def test_cpu_anchor_parse_keeps_last_record(tmp_path, monkeypatch):
+    """The anchor script APPENDS on re-runs; the bench record must carry
+    the freshest measurement, not the oldest (ADVICE r3). Malformed or
+    key-missing lines are skipped without losing earlier good ones."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    log = tmp_path / "logs" / "torch_cpu_anchor.log"
+    log.parent.mkdir()
+    log.write_text(
+        "# methodology note\n"
+        '{"flax_over_torch": 1.18, "host": "loaded"}\n'
+        '{"broken json\n'
+        '{"no_ratio_key": true}\n'
+        '{"flax_over_torch": 2.06, "host": "idle"}\n')
+    # _cpu_anchor_fields resolves the log relative to its module's
+    # __file__ — point that at tmp_path rather than patching the
+    # process-global os.path.dirname
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    fields = bench._cpu_anchor_fields()
+    assert fields["cpu_anchor_flax_over_torch"] == 2.06
+
+
 def test_watchdog_kills_stalled_child():
     # the stall threshold must outlast interpreter startup, which can
     # take >10 s on a loaded host — the fake child prints one line as
