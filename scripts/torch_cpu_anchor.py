@@ -37,8 +37,16 @@ def main():
     ap.add_argument("--width", type=int, default=512)
     ap.add_argument("--iters", type=int, default=6)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--train", action="store_true",
+                    help="anchor the full TRAINING step instead of the "
+                         "test-mode forward: sequence loss + backward + "
+                         "grad-clip + AdamW on both sides, identical "
+                         "hyperparameters (the reference's chairs-stage "
+                         "recipe at the demo geometry)")
     args = ap.parse_args()
     h, w, iters = args.height, args.width, args.iters
+    if args.train:
+        return train_anchor(args)
 
     import torch
 
@@ -91,6 +99,100 @@ def main():
         "torch_iters_per_sec": round(iters / torch_s, 3),
         "flax_iters_per_sec": round(iters / jax_s, 3),
         "flax_over_torch": round(torch_s / jax_s, 3),
+        "host": "2-core CPU (build container)",
+    }), flush=True)
+
+
+def train_anchor(args):
+    """Full training step, torch reference vs flax, same CPU.
+
+    Both sides run: forward with per-iteration outputs -> the
+    gamma-weighted sequence loss (train.py:42-73 semantics, re-derived)
+    -> backward -> grad-clip 1.0 -> AdamW(lr 2e-4, wd 1e-5). No AMP on
+    either side (CPU), no remat on ours (the reference stores all
+    activations, so the fair memory/compute tradeoff is store-all).
+    """
+    h, w, iters = args.height, args.width, args.iters
+
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    rng = np.random.default_rng(0)
+    im1 = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+    im2 = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+    gt = rng.normal(0, 3, (1, h, w, 2)).astype(np.float32)
+
+    # ---- reference torch training step ----
+    from dexiraft_tpu.interop.reference import build_reference_v5
+
+    tm = build_reference_v5()
+    tm.train()
+    opt = torch.optim.AdamW(tm.parameters(), lr=2e-4, weight_decay=1e-5)
+    t1 = torch.from_numpy(im1.transpose(0, 3, 1, 2))
+    t2 = torch.from_numpy(im2.transpose(0, 3, 1, 2))
+    tgt = torch.from_numpy(gt.transpose(0, 3, 1, 2))
+    tvalid = torch.ones(1, h, w)
+
+    def torch_seq_loss(preds):
+        # gamma-weighted L1 over iteration outputs, masked by
+        # valid & |gt|<400 (train.py:42-73), gamma=0.8
+        mag = torch.sum(tgt ** 2, dim=1).sqrt()
+        valid = (tvalid >= 0.5) & (mag < 400)
+        loss = 0.0
+        n = len(preds)
+        for i, p in enumerate(preds):
+            w_i = 0.8 ** (n - i - 1)
+            loss = loss + w_i * (valid[:, None] * (p - tgt).abs()).mean()
+        return loss
+
+    def torch_step():
+        preds = tm(t1, t2, iters=iters)
+        loss = torch_seq_loss(preds)
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(tm.parameters(), 1.0)
+        opt.step()
+        return float(loss)
+
+    torch_step()  # warm
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        torch_step()
+    torch_s = (time.perf_counter() - t0) / args.reps
+    print(f"[anchor] torch train step {torch_s * 1e3:.0f} ms",
+          file=sys.stderr)
+
+    # ---- our training step, same process/load ----
+    from dexiraft_tpu.config import TrainConfig, raft_v5
+    from dexiraft_tpu.train.state import create_state
+    from dexiraft_tpu.train.step import make_train_step
+
+    cfg = raft_v5(mixed_precision=False)  # remat off: store-all like torch
+    tc = TrainConfig(name="anchor", num_steps=100, batch_size=1,
+                     image_size=(h, w), iters=iters, lr=2e-4, wdecay=1e-5,
+                     clip=1.0)
+    state = create_state(jax.random.PRNGKey(0), cfg, tc)
+    step_fn = make_train_step(cfg, tc)
+    batch = {"image1": jnp.asarray(im1), "image2": jnp.asarray(im2),
+             "flow": jnp.asarray(gt), "valid": jnp.ones((1, h, w))}
+    state, metrics = step_fn(state, batch)  # compile + warm
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        state, metrics = step_fn(state, batch)
+        float(metrics["loss"])  # sync
+    jax_s = (time.perf_counter() - t0) / args.reps
+    print(f"[anchor] flax train step {jax_s * 1e3:.0f} ms", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"cpu_anchor_v5_trainstep@{h}x{w}x{iters}it",
+        "torch_ms": round(torch_s * 1e3, 1),
+        "flax_ms": round(jax_s * 1e3, 1),
+        "flax_over_torch_train": round(torch_s / jax_s, 3),
         "host": "2-core CPU (build container)",
     }), flush=True)
 
