@@ -49,8 +49,11 @@ class RAFTConfig:
     # transposed-conv implementation inside the embedded DexiNed's
     # upsamplers: "transpose" (lax.conv_transpose) or "subpixel" (the
     # numerically identical phase-decomposed form — dense half-res convs
-    # instead of an input-dilated full-res conv; see models/dexined.py)
-    dexined_upconv: str = "transpose"
+    # instead of an input-dilated full-res conv; see models/dexined.py).
+    # Default flipped to "subpixel" after the on-chip A/B: end-to-end v5
+    # forward at 440x1024 dropped 175.9 -> 100.0 ms (allpairs path),
+    # prelude ~104 -> ~26 ms (logs/tpu_queue_r4/bench_record.log).
+    dexined_upconv: str = "subpixel"
     # unroll factor for the refinement-loop scan (lax.scan unroll): >1
     # lets XLA software-pipeline consecutive iterations (fuse the next
     # lookup's hat-matrix build with the current GRU) at the cost of

@@ -61,7 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "one-to-one correspondPixels protocol; 'dilation' "
                         "is the fast surrogate (scores trend higher, "
                         "docs/parity.md)")
-    p.add_argument("--upconv", default="transpose",
+    p.add_argument("--upconv", default="subpixel",
                    choices=("transpose", "subpixel"),
                    help="upsampler implementation (numerically "
                         "identical; subpixel avoids input-dilated "

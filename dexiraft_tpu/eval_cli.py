@@ -39,7 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan_unroll", type=int, default=1,
                    help="refinement-scan unroll factor (XLA pipelining "
                         "knob; numerically identical)")
-    p.add_argument("--dexined_upconv", default="transpose",
+    p.add_argument("--dexined_upconv", default="subpixel",
                    choices=["transpose", "subpixel"],
                    help="embedded-DexiNed upsampler implementation "
                         "(numerically identical; see docs/perf.md)")

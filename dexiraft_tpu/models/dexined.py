@@ -197,7 +197,7 @@ class UpConvBlock(nn.Module):
 
     up_scale: int
     dtype: Any = jnp.float32
-    upconv: str = "transpose"
+    upconv: str = "subpixel"
 
     @nn.compact
     def __call__(self, x):
@@ -257,7 +257,11 @@ class DexiNed(nn.Module):
 
     dtype: Any = jnp.float32
     fusion: str = "cat"
-    upconv: str = "transpose"
+    # "subpixel" is the shipped default everywhere (config.py, CLIs):
+    # identical params/outputs to "transpose", 5x faster on-chip
+    # (docs/perf.md r4 A/B) and avoids a pathological multi-minute XLA
+    # conv_transpose compile at full eval resolution.
+    upconv: str = "subpixel"
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> List[jax.Array]:

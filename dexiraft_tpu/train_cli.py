@@ -60,7 +60,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "drops the per-iteration hat matrices (the "
                         "dominant training-memory term) far cheaper than "
                         "full --remat")
-    p.add_argument("--dexined_upconv", default="transpose",
+    p.add_argument("--dexined_upconv", default="subpixel",
                    choices=["transpose", "subpixel"],
                    help="embedded-DexiNed upsampler implementation "
                         "(numerically identical; see docs/perf.md)")

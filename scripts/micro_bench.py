@@ -2,12 +2,14 @@
 
 Times, with the float-sync pattern (block_until_ready does not reliably
 block through the relay tunnel):
-  rtt        scalar fetch on a trivial jitted fn (the measurement floor)
-  prelude    DexiNed(x2) + 4 encoder passes at eval res
-  volume     all-pairs matmul + pyramid (x2 streams)
-  lookup32   32 chained corr_lookup calls (both streams, carry-dependent)
-  update32   32 chained update-block iterations without lookup
-  forward    the full v5 test-mode forward (sanity: ~ sum of the above)
+  rtt          scalar fetch on a trivial jitted fn (the measurement floor)
+  volume       all-pairs matmul + pyramid (x2 streams)
+  dexi_b_bf16  the shipped DexiNed prelude (one batched bf16 call)
+  enc_x4       4 encoder passes at eval res
+  lookup32     32 chained corr_lookup calls (both streams, carry-dependent)
+  forward      the full v5 test-mode forward (sanity: ~ sum of the above)
+  fwd_iter1    iters=1 forward -> per-iteration + prelude split
+  fwd_sp_unr4  candidate config: scan_unroll=4 (XLA software pipelining)
 
 Run:  python scripts/micro_bench.py [--impl allpairs]
 """
@@ -43,7 +45,7 @@ def timeit(name, fn, *args, reps=3):
     for _ in range(reps):
         float(reduced(*args))
     dt = (time.perf_counter() - t0) / reps
-    print(f"{name:>10s}: {dt * 1e3:8.1f} ms   (-rtt {max(dt - _RTT[0], 0) * 1e3:8.1f} ms)")
+    print(f"{name:>11s}: {dt * 1e3:8.1f} ms   (-rtt {max(dt - _RTT[0], 0) * 1e3:8.1f} ms)")
     return dt
 
 
@@ -76,23 +78,19 @@ def main() -> None:
     timeit("volume", volume, f1, f2)
 
     # --- DexiNed + encoders at eval res ---
+    # (the historical fp32 two-call "dexined_x2" comparison is gone: its
+    # conv_transpose graph at full 440x1024 compiled for >20 min on-chip
+    # and timed the whole job out, 2026-08-02 queue run. The shipped
+    # config is the batched bf16 call below; the transpose-vs-subpixel
+    # A/B lives in prelude_profile.py and the bench 4-config sweep.)
     from dexiraft_tpu.models.dexined import DexiNed
 
-    dexi = DexiNed(dtype=jnp.float32)
     dimg = jnp.zeros((1, 64, 64, 3), jnp.float32)
-    dvars = jax.jit(lambda r, x: dexi.init(r, x, train=False))(
-        jax.random.PRNGKey(2), dimg)
     big = jax.random.uniform(jax.random.PRNGKey(3),
                              (1, HEIGHT, WIDTH, 3), jnp.float32, -1, 1)
 
-    def dexined2(a):
-        return (dexi.apply(dvars, a, train=False)[-1],
-                dexi.apply(dvars, -a, train=False)[-1])
-
-    timeit("dexined_x2", dexined2, big)
-
     # the shipped v5 configuration: ONE batched call, bf16 body
-    dexi16 = DexiNed(dtype=jnp.bfloat16)
+    dexi16 = DexiNed(dtype=jnp.bfloat16, upconv="subpixel")
     dvars16 = jax.jit(lambda r, x: dexi16.init(r, x, train=False))(
         jax.random.PRNGKey(2), dimg)
 
@@ -167,24 +165,11 @@ def main() -> None:
           f"prelude+1 {t_one * 1e3:.1f} ms; "
           f"lookup32/iter {t_lookup / ITERS * 1e3:6.1f} ms")
 
-    # --- same forward with the subpixel upconv (identical params/tree:
-    # the impls are checkpoint-interchangeable) — the e2e A/B ---
-    cfg_s = raft_v5(mixed_precision=True, corr_impl=args.impl,
-                    dexined_upconv="subpixel")
-    model_s = RAFT(cfg_s)
-
-    @jax.jit
-    def fwd_s(a, b):
-        low, up = model_s.apply(variables, a, b, iters=ITERS, train=False,
-                                test_mode=True)
-        return jnp.sum(low) + jnp.sum(up)
-
-    timeit("fwd_subpix", fwd_s, im1, im2)
-
-    # --- candidate shipping config: subpixel upconv + 4x unrolled scan
-    # (XLA can software-pipeline consecutive refinement iterations) ---
+    # --- candidate shipping config: subpixel upconv (now the default)
+    # + 4x unrolled scan (XLA can software-pipeline consecutive
+    # refinement iterations) ---
     cfg_u = raft_v5(mixed_precision=True, corr_impl=args.impl,
-                    dexined_upconv="subpixel", scan_unroll=4)
+                    scan_unroll=4)
     model_u = RAFT(cfg_u)
 
     @jax.jit

@@ -150,7 +150,7 @@ def test_dexined_upconv_impls_equivalent():
     # whole-model check incl. checkpoint interop: variables initialized by
     # the transpose impl drive the subpixel impl to the same 7 maps
     x = jax.random.uniform(jax.random.PRNGKey(1), (1, 48, 64, 3), maxval=255.0)
-    m_t = DexiNed()
+    m_t = DexiNed(upconv="transpose")
     m_s = DexiNed(upconv="subpixel")
     variables = m_t.init(jax.random.PRNGKey(0), x)
     out_t = m_t.apply(variables, x)
