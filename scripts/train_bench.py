@@ -83,6 +83,24 @@ def main():
           f"{1.0 / dt:.2f} steps/s  "
           f"{args.batch * args.iters / dt:.1f} pair-iters/s")
 
+    # peak HBM: the VERDICT training-record ask is steps/s AND memory
+    # headroom at this geometry. memory_stats() is backend-dependent —
+    # absent (None / missing keys) on some relay backends, so report
+    # best-effort and never fail the measurement over it.
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        if peak is not None:
+            gib = peak / 2**30
+            lim = f" / {limit / 2**30:.2f} GiB limit" if limit else ""
+            print(f"peak HBM {gib:.2f} GiB{lim}")
+        else:
+            print(f"memory_stats keys: {sorted(stats) or 'unavailable'}",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"memory_stats unavailable: {e}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()
