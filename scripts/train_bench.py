@@ -30,6 +30,12 @@ def main():
     ap.add_argument("--size", type=int, nargs=2, default=(368, 496))
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--remat_lookup", action="store_true")
+    ap.add_argument("--mem_only", action="store_true",
+                    help="compile-only: print the executable's "
+                         "memory_analysis and exit WITHOUT executing. "
+                         "This is how the no-remat OOM proof is "
+                         "captured — actually running an OOM-bound "
+                         "step can wedge the relay tunnel")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (shakeout while the "
                          "tunnel is down; config.update beats the "
@@ -68,6 +74,39 @@ def main():
         "valid": jnp.ones((args.batch, h, w), jnp.float32),
     }
 
+    if args.mem_only:
+        # compile WITHOUT executing: the memory_analysis of the
+        # executable is the OOM proof (requirements vs the chip limit)
+        # with no allocation and so no tunnel-wedging OOM crash
+        t0 = time.perf_counter()
+        compiled = step_fn.lower(state, batch).compile()
+        print(f"compile-only {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        try:
+            mem = compiled.memory_analysis()
+            for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                         "temp_size_in_bytes", "alias_size_in_bytes",
+                         "generated_code_size_in_bytes"):
+                v = getattr(mem, attr, None)
+                if v is not None:
+                    print(f"{attr}: {v / 2**30:.2f} GiB")
+            total = sum(getattr(mem, a, 0) or 0
+                        for a in ("argument_size_in_bytes",
+                                  "output_size_in_bytes",
+                                  "temp_size_in_bytes"))
+            total -= getattr(mem, "alias_size_in_bytes", 0) or 0
+            print(f"total (args+out+temp-alias): {total / 2**30:.2f} GiB")
+        except Exception as e:
+            print(f"memory_analysis unavailable: {e}", file=sys.stderr)
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit")
+            if limit:
+                print(f"chip bytes_limit: {limit / 2**30:.2f} GiB")
+        except Exception:
+            pass
+        return
+
     t0 = time.perf_counter()
     state, metrics = step_fn(state, batch)
     float(metrics["loss"])  # forced host sync (block_until_ready unreliable)
@@ -82,6 +121,25 @@ def main():
     print(f"steady-state {dt * 1e3:.1f} ms/step  "
           f"{1.0 / dt:.2f} steps/s  "
           f"{args.batch * args.iters / dt:.1f} pair-iters/s")
+
+    # whole-train-step FLOPs from XLA's cost analysis of the compiled
+    # executable, and MFU against the chip's bf16 peak (VERDICT r4
+    # next-3). The AOT lower().compile() hits the persistent disk
+    # cache (queue env / bench default), not the in-memory jit cache.
+    # Never fail the throughput record over accounting.
+    try:
+        from bench import CHIP_PEAK_BF16_FLOPS, _counted_flops
+        flops = _counted_flops(step_fn, state, batch)
+        if flops:
+            print(f"train-step FLOPs {flops / 1e12:.3f} TFLOP  "
+                  f"({flops / dt / 1e12:.1f} TFLOP/s)")
+            kind = getattr(jax.devices()[0], "device_kind", "unknown")
+            peak = CHIP_PEAK_BF16_FLOPS.get(kind)
+            if peak and jax.devices()[0].platform == "tpu":
+                print(f"train-step MFU {flops / dt / peak:.3f} "
+                      f"(peak {peak / 1e12:.0f} bf16 TFLOP/s, {kind})")
+    except Exception as e:
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
 
     # peak HBM: the VERDICT training-record ask is steps/s AND memory
     # headroom at this geometry. memory_stats() is backend-dependent —
