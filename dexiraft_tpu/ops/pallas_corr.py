@@ -46,10 +46,14 @@ _PIXEL_BLOCK = 256
 def _pixel_block() -> int:
     import os
 
+    # the batched variant stages (P, k, k, C) fp32 patches in VMEM
+    # (~100 KiB per pixel at C=256, r=4), so its default block must be
+    # much smaller than the loop kernel's
+    default = 32 if _variant() == "batched" else _PIXEL_BLOCK
     # clamp: a bad flag must fail soft, not as a ZeroDivisionError deep
     # inside jit tracing
     return max(1, int(os.environ.get("DEXIRAFT_PALLAS_PIXEL_BLOCK",
-                                     _PIXEL_BLOCK)))
+                                     default)))
 
 
 def _interpret_default() -> bool:
@@ -62,11 +66,79 @@ def _interpret_default() -> bool:
     return os.environ.get("DEXIRAFT_PALLAS_INTERPRET", "0") == "1"
 
 
+def _variant() -> str:
+    # "loop": the original per-pixel slice+reduce kernel.
+    # "batched": per-pixel work reduced to a pure patch COPY into a
+    # (P, k, k, C) scratch, then ONE vectorized multiply-reduce over the
+    # whole block — the shape the VPU pipelines well (the per-pixel
+    # (k,k,C) reduce of "loop" is latency-bound, VERDICT r4 weak-6).
+    # Costs P*k*k*C*4 B of extra VMEM, so "batched" wants a SMALLER
+    # pixel block (default 32 vs 256). Trace-time switch; the on-chip
+    # A/B lives in scripts/tpu_smoke.py.
+    import os
+
+    v = os.environ.get("DEXIRAFT_PALLAS_VARIANT", "loop")
+    return v if v in ("loop", "batched") else "loop"
+
+
+def _blend_corners(lattice, frac_ref, out_ref):
+    """Bilinear-blend the (P, k, k) integer-lattice dots into the
+    (P, win*win) output window, x offset on the slow axis (the reference
+    channel order — ops.corr)."""
+    p_block, k, _ = lattice.shape
+    win = k - 1
+    fx = frac_ref[0, :, 0].reshape(p_block, 1, 1)
+    fy = frac_ref[0, :, 1].reshape(p_block, 1, 1)
+    tl = lattice[:, 0:win, 0:win]
+    tr = lattice[:, 0:win, 1:win + 1]
+    bl = lattice[:, 1:win + 1, 0:win]
+    br = lattice[:, 1:win + 1, 1:win + 1]
+    out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
+           + fy * (1 - fx) * bl + fy * fx * br)
+    out_ref[0] = out.swapaxes(1, 2).reshape(p_block, win * win)
+
+
+def _corr_kernel_batched(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref,
+                         sxv_ref, syv_ref, out_ref, patches_ref,
+                         *, radius: int, h2: int, w2: int):
+    r = radius
+    k = 2 * r + 2
+    p_block = f1_ref.shape[1]
+    c = f1_ref.shape[2]
+    inv_sqrt_c = 1.0 / (c ** 0.5)
+
+    # phase 1: pure data movement — stage every pixel's (k, k, C) patch
+    # into the block scratch; no per-pixel compute on the critical path
+    def body(p, _):
+        sx = sx_ref[0, p]
+        sy = sy_ref[0, p]
+        patches_ref[pl.ds(p, 1)] = f2_ref[0, pl.ds(sy, k), pl.ds(sx, k), :][None]
+        return 0
+
+    jax.lax.fori_loop(0, p_block, body, 0)
+
+    # phase 2: ONE vectorized multiply-reduce over the whole block
+    patches = patches_ref[:].astype(jnp.float32)          # (P, k, k, C)
+    f1 = f1_ref[0].astype(jnp.float32)                    # (P, C)
+    dots = jnp.sum(patches * f1[:, None, None, :], axis=3)  # (P, k, k)
+
+    # vectorized out-of-frame mask: true lattice origin per pixel is
+    # (sx - (r + 2), sy - (r + 2)) — see the loop kernel's derivation
+    sxv = sxv_ref[0]                                      # (P,) int32
+    syv = syv_ref[0]
+    gx = (jax.lax.broadcasted_iota(jnp.int32, (p_block, k, k), 2)
+          + (sxv - 2 - 2 * r)[:, None, None])
+    gy = (jax.lax.broadcasted_iota(jnp.int32, (p_block, k, k), 1)
+          + (syv - 2 - 2 * r)[:, None, None])
+    valid = (gx >= 0) & (gx < w2) & (gy >= 0) & (gy < h2)
+    dots = jnp.where(valid, dots * inv_sqrt_c, 0.0)
+    _blend_corners(dots, frac_ref, out_ref)
+
+
 def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
                  lattice_ref, *, radius: int, h2: int, w2: int):
     r = radius
     k = 2 * r + 2
-    win = 2 * r + 1
     p_block = f1_ref.shape[1]
     c = f1_ref.shape[2]
     inv_sqrt_c = 1.0 / (c ** 0.5)
@@ -92,17 +164,7 @@ def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
 
     jax.lax.fori_loop(0, p_block, body, 0)
 
-    lattice = lattice_ref[:].reshape(p_block, k, k)
-    fx = frac_ref[0, :, 0].reshape(p_block, 1, 1)
-    fy = frac_ref[0, :, 1].reshape(p_block, 1, 1)
-    tl = lattice[:, 0:win, 0:win]
-    tr = lattice[:, 0:win, 1:win + 1]
-    bl = lattice[:, 1:win + 1, 0:win]
-    br = lattice[:, 1:win + 1, 1:win + 1]
-    out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
-           + fy * (1 - fx) * bl + fy * fx * br)
-    # x offset on the slow axis (reference channel order — ops.corr)
-    out_ref[0] = out.swapaxes(1, 2).reshape(p_block, win * win)
+    _blend_corners(lattice_ref[:].reshape(p_block, k, k), frac_ref, out_ref)
 
 
 def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
@@ -143,30 +205,48 @@ def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
     frac_flat = flat(frac, 1)
 
     grid = (b, np_tot // pixel_block)
-    kernel = functools.partial(_corr_kernel, radius=r, h2=h2, w2=w2)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, pixel_block, c), lambda bi, ti: (bi, ti, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h2 + 2 * pad, w2 + 2 * pad, c),
-                         lambda bi, ti: (bi, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, pixel_block, 2), lambda bi, ti: (bi, ti, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, pixel_block, win * win),
-                               lambda bi, ti: (bi, ti, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, np_tot, win * win), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((pixel_block, k * k), jnp.float32)],
-        interpret=interpret,
-    )(sx_flat, sy_flat, f1_flat, f2p, frac_flat)
+    smem_spec = pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
+                             memory_space=pltpu.SMEM)
+    vmem_vec_spec = pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
+                                 memory_space=pltpu.VMEM)
+    f1_spec = pl.BlockSpec((1, pixel_block, c), lambda bi, ti: (bi, ti, 0),
+                           memory_space=pltpu.VMEM)
+    f2_spec = pl.BlockSpec((1, h2 + 2 * pad, w2 + 2 * pad, c),
+                           lambda bi, ti: (bi, 0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    frac_spec = pl.BlockSpec((1, pixel_block, 2), lambda bi, ti: (bi, ti, 0),
+                             memory_space=pltpu.VMEM)
+    out_specs = pl.BlockSpec((1, pixel_block, win * win),
+                             lambda bi, ti: (bi, ti, 0),
+                             memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((b, np_tot, win * win), jnp.float32)
+
+    if _variant() == "batched":
+        kernel = functools.partial(_corr_kernel_batched, radius=r,
+                                   h2=h2, w2=w2)
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            # slice starts twice: SMEM scalars drive the dynamic patch
+            # slices, VMEM vectors feed the vectorized lattice mask
+            in_specs=[smem_spec, smem_spec, f1_spec, f2_spec, frac_spec,
+                      vmem_vec_spec, vmem_vec_spec],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((pixel_block, k, k, c), jnp.float32)],
+            interpret=interpret,
+        )(sx_flat, sy_flat, f1_flat, f2p, frac_flat, sx_flat, sy_flat)
+    else:
+        kernel = functools.partial(_corr_kernel, radius=r, h2=h2, w2=w2)
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[smem_spec, smem_spec, f1_spec, f2_spec, frac_spec],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((pixel_block, k * k), jnp.float32)],
+            interpret=interpret,
+        )(sx_flat, sy_flat, f1_flat, f2p, frac_flat)
 
     return out[:, :n].reshape(b, h, w, win * win)
 

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Run a CPU-only workload in the background WITHOUT polluting on-chip
+# timing: while the TPU queue has a job in flight (its RTT-differenced
+# timings are host-sensitive on this 2-core box), the workload is
+# SIGSTOPped; it resumes when the chip job finishes. Safe because the
+# workload is CPU-only — stopping it cannot wedge the relay tunnel.
+#
+# Usage: bash scripts/cpu_bg_run.sh <queue_log> <cmd...>
+
+set -u
+QLOG=$1; shift
+nice -n 19 "$@" &
+PID=$!
+# never leave the child frozen: if this wrapper dies (TERM/INT/exit)
+# while the workload is SIGSTOPped, resume it on the way out
+trap 'kill -CONT "$PID" 2>/dev/null' EXIT
+
+queue_busy() {
+  [ -f "$QLOG" ] || return 1
+  # a queue that died mid-job leaves a dangling 'start' line — only
+  # trust it while a queue process is actually alive
+  pgrep -f "tpu_queue.sh" >/dev/null || return 1
+  local s d
+  s=$(grep -n ' start ' "$QLOG" | tail -1 | cut -d: -f1)
+  d=$(grep -n ' done ' "$QLOG" | tail -1 | cut -d: -f1)
+  [ -n "$s" ] && [ "${d:-0}" -lt "$s" ]
+}
+
+stopped=0
+while kill -0 "$PID" 2>/dev/null; do
+  if queue_busy; then
+    [ "$stopped" -eq 0 ] && kill -STOP "$PID" && stopped=1
+  else
+    [ "$stopped" -eq 1 ] && kill -CONT "$PID" && stopped=0
+  fi
+  sleep 30
+done
+wait "$PID"

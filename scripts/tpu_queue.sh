@@ -97,10 +97,11 @@ run_all() {
   # possibly-cold compiles (bench sweep, train-step graphs, long demo).
   # 1. the official metric JSON (VERDICT next-1); warm cache -> fast.
   #    Also keeps the cache hot for the driver's own end-of-round run.
-  #    BENCH_HARD_CAP_S < the outer timeout so bench's own watchdog —
-  #    which gets the JSON record out and falls back cleanly — ends a
-  #    stuck run, never this timeout's SIGTERM.
-  run bench_record  1200 env BENCH_HARD_CAP_S=1000 python bench.py
+  #    BENCH_HARD_CAP_S + the ~5-min CPU-fallback child < the outer
+  #    timeout, so bench's own watchdog — which gets the JSON record
+  #    out and falls back cleanly — ends a stuck run, never this
+  #    timeout's SIGTERM (cap 850 + fallback ~300 < 1200).
+  run bench_record  1200 env BENCH_HARD_CAP_S=850 python bench.py
   # 2. flagship v5 training at chairs geometry (next-2): steps/s + HBM
   #    for the two remat options, plus the no-remat proof as a
   #    compile-only memory_analysis (running it for real would OOM and
@@ -119,8 +120,11 @@ run_all() {
   # 6. component-level forward numbers (r4 rc=124 fixed: dexined_x2
   #    config removed; warm cache)
   run micro_bench   900 python scripts/micro_bench.py
-  # 7. accuracy evidence at 10x pool (next-7): on-chip v5 long demo
-  #    (42 steps/s on chip at this geometry -> compute is minutes) + edge
+  # 7. accuracy evidence at 10x pool (next-7): on-chip long demos for
+  #    v1-small AND the v5 flagship (42 steps/s on chip at this
+  #    geometry -> compute is minutes; ckpt_dir so a mid-run tunnel
+  #    death resumes instead of restarting) + edge
+  run v1_demo_big   1200 python scripts/train_demo.py --variant small --steps 5000 --batch 4 --size 192 256 --pool 80 --heldout_every 1000 --ckpt_dir logs/v1_demo_r5_ckpt --log logs/v1_demo_r5.log
   run v5_demo_big   1200 python scripts/train_demo.py --variant v5 --steps 3000 --batch 2 --size 192 256 --pool 80 --heldout_every 500 --ckpt_dir logs/v5_demo_r5_ckpt --log logs/v5_demo_r5.log
   run dexined_demo  900 python scripts/dexined_demo.py --steps 300
 }

@@ -13,6 +13,15 @@ from dexiraft_tpu.ops.local_corr import local_corr_level
 from dexiraft_tpu.ops.pallas_corr import pallas_local_corr_level
 
 
+@pytest.fixture(autouse=True, params=["loop", "batched"])
+def _kernel_variant(request, monkeypatch):
+    """Every parity/grad case runs against BOTH kernel shapes (the
+    per-pixel loop and the staged-patches batched reduce) — the variant
+    is a trace-time env switch, ops/pallas_corr.py:_variant."""
+    monkeypatch.setenv("DEXIRAFT_PALLAS_VARIANT", request.param)
+    return request.param
+
+
 def _setup(key, b=1, h=8, w=16, c=128, noise=3.0):
     k1, k2, k3 = jax.random.split(key, 3)
     f1 = jax.random.normal(k1, (b, h, w, c), jnp.float32)
