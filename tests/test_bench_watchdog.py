@@ -18,10 +18,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def test_cpu_anchor_parse_keeps_last_record(tmp_path, monkeypatch):
-    """The anchor script APPENDS on re-runs; the bench record must carry
-    the freshest measurement, not the oldest (ADVICE r3). Malformed or
-    key-missing lines are skipped without losing earlier good ones."""
+def test_cpu_anchor_parse_keeps_freshest_per_geometry(tmp_path, monkeypatch):
+    """The anchor script APPENDS on re-runs; the bench record carries one
+    ratio per measured geometry, each the freshest for that geometry
+    (ADVICE r3 + VERDICT r4 next-8). Malformed lines, key-missing lines,
+    and legacy geometry-less records are skipped without losing good
+    ones."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
@@ -32,16 +34,26 @@ def test_cpu_anchor_parse_keeps_last_record(tmp_path, monkeypatch):
     log.parent.mkdir()
     log.write_text(
         "# methodology note\n"
-        '{"flax_over_torch": 1.18, "host": "loaded"}\n'
+        '{"flax_over_torch": 1.18, "host": "loaded"}\n'  # legacy: no metric
         '{"broken json\n'
         '{"no_ratio_key": true}\n'
-        '{"flax_over_torch": 2.06, "host": "idle"}\n')
+        '{"metric": "cpu_anchor_v5_forward@224x512x6it",'
+        ' "flax_over_torch": 1.9, "host": "loaded"}\n'
+        '{"metric": "cpu_anchor_v5_forward@224x512x6it",'
+        ' "flax_over_torch": 2.06, "host": "idle"}\n'
+        '{"metric": "cpu_anchor_v5_forward@440x1024x32it",'
+        ' "flax_over_torch": 1.27}\n'
+        '{"metric": "cpu_anchor_v5_trainstep@96x128x12it",'
+        ' "flax_over_torch_train": 0.23}\n')
     # _cpu_anchor_fields resolves the log relative to its module's
     # __file__ — point that at tmp_path rather than patching the
     # process-global os.path.dirname
     monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
     fields = bench._cpu_anchor_fields()
-    assert fields["cpu_anchor_flax_over_torch"] == 2.06
+    assert fields["cpu_anchor_flax_over_torch"] == {
+        "224x512x6it": 2.06, "440x1024x32it": 1.27}
+    assert fields["cpu_anchor_flax_over_torch_train"] == {
+        "96x128x12it": 0.23}
 
 
 def test_watchdog_kills_stalled_child():
