@@ -484,19 +484,21 @@ def main() -> None:
                  f"loop {loop_rate and round(loop_rate, 1)} iters/s")
         return iters / dt, loop_rate, diag
 
-    # both first-class corr paths are measured: the materialized MXU
-    # volume and the memory-efficient on-demand path (the alt_cuda_corr
-    # analog the north-star metric names, BASELINE.json); the faster one
-    # is the headline — a user picks it with one config flag. The
-    # DexiNed upconv A/B (transposed conv vs the identical-map subpixel
-    # phase form) is kept on both corr paths as a diagnostic. The r4
-    # on-chip sweep (logs/tpu_queue_r4/bench_record.log) settled the
-    # ordering — allpairs/subpixel won by 1.24x over the runner-up — so
-    # the sweep runs BEST-KNOWN-FIRST: if the relay dies mid-sweep, the
-    # record that survives is the headline config, not an A/B leg. The
-    # upconv choice only changes the prelude, so the transpose variants
-    # skip the marginal-loop (1-iter) re-measurement and inherit the
-    # loop rate of their subpixel sibling on the same corr path.
+    # all three first-class corr paths are measured: the materialized
+    # MXU volume, the memory-efficient on-demand path (the alt_cuda_corr
+    # analog the north-star metric names, BASELINE.json), and the Pallas
+    # VMEM kernel (implemented and parity-tested; in the official sweep
+    # per VERDICT r4 §2.2); the fastest is the headline — a user picks
+    # it with one config flag. The DexiNed upconv A/B (transposed conv
+    # vs the identical-map subpixel phase form) is kept on both
+    # non-Pallas corr paths as a diagnostic. The r4 on-chip sweep
+    # (logs/tpu_queue_r4/bench_record.log) settled the ordering —
+    # allpairs/subpixel won by 1.24x over the runner-up — so the sweep
+    # runs BEST-KNOWN-FIRST: if the relay dies mid-sweep, the record
+    # that survives is the headline config, not an A/B leg. The upconv
+    # choice only changes the prelude, so the transpose variants skip
+    # the marginal-loop (1-iter) re-measurement and inherit the loop
+    # rate of their subpixel sibling on the same corr path.
     allpairs_ips, allpairs_loop, ap_diag = measure("allpairs", "subpixel")
     diag = {f"allpairs_{k}": v for k, v in ap_diag.items()}
     candidates = [("allpairs", "subpixel", allpairs_ips, allpairs_loop)]
@@ -507,9 +509,13 @@ def main() -> None:
     hard_cap_s = float(os.environ.get("BENCH_HARD_CAP_S", HARD_CAP_S))
     secondary_budget_s = float(os.environ.get("BENCH_SECONDARY_BUDGET_S",
                                               hard_cap_s - 550))
-    if on_tpu:  # secondary metrics; not worth CPU-fallback time
+    if on_tpu:  # secondary metrics; not worth CPU-fallback time.
+        # pallas is on-tpu-only by the same guard: on CPU the kernel
+        # runs in interpreter mode — minutes per forward at full
+        # geometry, with nothing to learn from the timing
         for corr_impl, upconv, tag in (
                 ("local", "subpixel", "local"),
+                ("pallas", "subpixel", "pallas"),
                 ("allpairs", "transpose", "allpairs_transpose"),
                 ("local", "transpose", "local_transpose")):
             if time.perf_counter() - _T0 > secondary_budget_s:
@@ -612,6 +618,7 @@ def main() -> None:
             else None),
         "allpairs_iters_per_sec": round(allpairs_ips, 2),
         "local_corr_iters_per_sec": local_ips,
+        "pallas_corr_iters_per_sec": diag.get("pallas_iters_per_sec"),
         **diag,
         # flush: stdout is a block-buffered pipe under the watchdog
         # parent; if JAX teardown hangs after this point (observed with

@@ -127,6 +127,23 @@ class TrainConfig:
     gamma: float = 0.8
     iters: int = 12
     add_noise: bool = False
+    # training precision policy: "fp32", or "bf16" — the step forces the
+    # model's mixed-precision path (bf16 module compute; flax casts each
+    # op's params from the fp32 MASTER weights, so gradients land fp32)
+    # while loss, metrics, BN running stats, and optimizer math stay
+    # fp32. The model's own mixed-precision contract keeps the corr
+    # volume fp32. No loss scaling needed: bf16 keeps fp32's exponent
+    # range
+    precision: str = "fp32"
+    # gradient accumulation: the step's batch leading dim is
+    # (accum_steps * microbatch) and a lax.scan inside the ONE jitted
+    # step runs the microbatches sequentially, averaging gradients —
+    # large effective batches on one chip, compiled once. 1 = off
+    accum_steps: int = 1
+    # device-side prefetch depth: batches device_put ahead of the step
+    # consuming them (data.prefetch.DevicePrefetcher); 2 = classic
+    # double buffering. 0 disables the prefetcher entirely
+    prefetch_depth: int = 2
     # v1-lineage fusion (alt/train_1.py:173-176): run the SAME model on
     # (image1, image2) and on the edge-image pair, and sum the per-iter
     # flow predictions before the sequence loss; requires edge-pair data

@@ -27,6 +27,11 @@ from dexiraft_tpu.data.flow_io import (
 )
 from dexiraft_tpu.data.loader import Loader
 from dexiraft_tpu.data.padder import InputPadder
+from dexiraft_tpu.data.prefetch import (
+    DevicePrefetcher,
+    PrefetchStats,
+    prefetch_to_device,
+)
 
 __all__ = [
     "ColorJitter",
@@ -48,4 +53,7 @@ __all__ = [
     "read_gen",
     "Loader",
     "InputPadder",
+    "DevicePrefetcher",
+    "PrefetchStats",
+    "prefetch_to_device",
 ]

@@ -1,0 +1,156 @@
+"""Device-side double-buffered batch prefetch.
+
+`data.loader.Loader` already decodes AHEAD of the training loop into
+host RAM (threaded/process pool). This layer removes the remaining
+synchronous hop: the host→device transfer. `jax.device_put` is
+asynchronous — it enqueues a DMA and returns immediately — so keeping
+`depth` puts in flight means batch N+1 (and N+2, ...) is streaming onto
+the chips with the step's OWN input shardings while step N computes.
+The train step then starts without waiting on PCIe/DCN: its arguments
+are already resident (the classic double-buffering pattern; depth=2 is
+one buffer computing + one filling).
+
+Stall accounting: after warm-fill, any time spent inside `next()` of
+the HOST iterator is chip-starvation time (the host failed to keep
+ahead) — the number `scripts/train_bench.py` reports as
+`prefetch_stall`. The device_put enqueue itself is non-blocking, so it
+is deliberately not counted as stall.
+
+Donation interplay: the jitted step donates only its STATE argument
+(donate_argnums=0), never the batch, so a prefetched batch that is
+still queued for a future step is never invalidated by the current one.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+# parallel.mesh (and with it jax) is imported lazily: data/__init__ must
+# stay importable without jax so the Loader's SPAWNED process workers
+# don't pay a jax init just to decode numpy batches
+
+
+class PrefetchStats:
+    """Host-side starvation accounting for a DevicePrefetcher."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        """Zero the counters (warm_fill_s included) — e.g. to exclude a
+        bench's warmup steps from the steady-state record."""
+        self.batches = 0  # batches yielded (after warm-fill)
+        self.stall_s = 0.0  # time blocked on the HOST iterator
+        self.stalls = 0  # yields on which the host made us wait
+        self.warm_fill_s = 0.0  # initial fill (excluded from stall_s)
+
+    @property
+    def stall_per_batch_s(self) -> float:
+        return self.stall_s / self.batches if self.batches else 0.0
+
+    def summary(self) -> str:
+        return (f"{self.batches} batches, prefetch stall "
+                f"{self.stall_s * 1e3:.1f} ms total "
+                f"({self.stall_per_batch_s * 1e3:.3f} ms/batch, "
+                f"{self.stalls} stalled yields; warm fill "
+                f"{self.warm_fill_s * 1e3:.1f} ms)")
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches, keeping `depth` transfers in flight.
+
+    put: host batch -> on-device batch (e.g. parallel.mesh.batch_putter
+    result — device_put with the train step's input shardings). depth=2
+    is double buffering; depth=0 degrades to a synchronous put-per-yield
+    (useful as the parity baseline in tests).
+    """
+
+    def __init__(
+        self,
+        iterable: Iterable[Any],
+        put: Optional[Callable[[Any], Any]] = None,
+        *,
+        depth: int = 2,
+    ):
+        if depth < 0:
+            raise ValueError(f"depth must be >= 0, got {depth}")
+        if put is None:
+            from dexiraft_tpu.parallel.mesh import batch_putter
+
+            put = batch_putter(None)
+        self.put = put
+        self.depth = depth
+        self.stats = PrefetchStats()
+        self._it = iter(iterable)
+        self._buf: "collections.deque" = collections.deque()
+        self._warm = False
+        self._exhausted = False
+
+    # a host next() faster than this is "the batch was already decoded
+    # and waiting" — only waits above it count as a stalled yield (the
+    # call itself always costs some microseconds)
+    STALL_EPS_S = 1e-3
+
+    def _pull(self) -> bool:
+        """Enqueue one more host batch's transfer; False when exhausted.
+        The put only ENQUEUES (async dispatch) — the host-iterator next()
+        is the only blocking part, and that is what gets timed."""
+        if self._exhausted:
+            return False
+        t0 = time.perf_counter()
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self._exhausted = True
+            return False
+        dt = time.perf_counter() - t0
+        if self._warm:
+            self.stats.stall_s += dt
+            if dt > self.STALL_EPS_S:
+                self.stats.stalls += 1
+        else:
+            self.stats.warm_fill_s += dt
+        self._buf.append(self.put(batch))
+        return True
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if not self._warm:
+            # warm fill: depth+1 so the first yield already leaves
+            # `depth` batches in flight behind it
+            for _ in range(self.depth + 1):
+                self._pull()
+            self._warm = True
+        else:
+            self._pull()
+        if not self._buf:
+            raise StopIteration
+        self.stats.batches += 1
+        return self._buf.popleft()
+
+    def close(self) -> None:
+        """Close the underlying host iterator (e.g. a Loader generator,
+        whose feeder thread and worker pool stop on close) and drop the
+        buffered device batches so their device memory can be freed."""
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+        self._buf.clear()
+        self._exhausted = True
+
+
+def prefetch_to_device(
+    iterable: Iterable[Any],
+    mesh=None,
+    *,
+    depth: int = 2,
+) -> DevicePrefetcher:
+    """Convenience wrapper: prefetch with the train step's input layout
+    for `mesh` (parallel.mesh.batch_putter; plain device_put when None)."""
+    from dexiraft_tpu.parallel.mesh import batch_putter
+
+    return DevicePrefetcher(iterable, batch_putter(mesh), depth=depth)

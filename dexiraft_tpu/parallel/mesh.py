@@ -85,6 +85,38 @@ def shard_batch_spatial(batch: Any, mesh: Mesh) -> Any:
         lambda x: _put(x, sp if np.ndim(x) >= 3 else bo), batch)
 
 
+def batch_input_sharding(mesh: Mesh) -> NamedSharding:
+    """The sharding the jitted train step pins its batch argument to:
+    (data, seq) spatial when the mesh has a seq axis, else batch-only.
+    Shared by train.step and the device prefetcher — a prefetched batch
+    lands ALREADY in the step's input layout, so consuming it triggers
+    no resharding copy. Contract: one spec for the whole batch dict, so
+    every batch leaf must be >=3-D (B, H, ...) on a 2-D mesh — true for
+    image1/2, flow, valid, edges; a future <3-D leaf needs per-leaf
+    specs here AND in batch_putter (shard_batch_spatial already splits
+    by ndim on the put side)."""
+    return (spatial_sharding(mesh) if SEQ_AXIS in mesh.axis_names
+            else batch_sharding(mesh))
+
+
+def batch_putter(mesh: Optional[Mesh]):
+    """batch -> on-device batch, in the train step's input layout.
+
+    The transfer-side helper for data.prefetch.DevicePrefetcher: returns
+    a callable that device_puts a host batch dict with the SAME shardings
+    make_train_step pins via in_shardings (batch_input_sharding above —
+    same >=3-D-leaf contract on a 2-D mesh). jax.device_put is
+    asynchronous, so the returned callable only ENQUEUES the
+    host->device copy — the prefetcher keeps several in flight while
+    the current step computes. mesh=None: plain device_put to the
+    default device (single-chip)."""
+    if mesh is None:
+        return lambda batch: jax.tree.map(jax.device_put, batch)
+    if SEQ_AXIS in mesh.axis_names:
+        return lambda batch: shard_batch_spatial(batch, mesh)
+    return lambda batch: shard_batch(batch, mesh)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully replicated (parameters, optimizer state, scalars)."""
     return NamedSharding(mesh, P())

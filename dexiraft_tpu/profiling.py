@@ -1,21 +1,82 @@
 """Profiling / tracing (SURVEY.md §5 — absent in the reference, where the
 only timing is DexiNed's per-image time.time() deltas, main.py:133-147).
 
-Two tools:
+Tools:
   * trace(log_dir): context manager around jax.profiler for a window of
     steps — inspect with TensorBoard's profile plugin or Perfetto.
   * StepTimer: wall-clock per-step timing with warmup exclusion; the
     train Logger separately reports steps/sec and iters/sec (the
     north-star throughput metric).
+  * enable_persistent_cache(dir): persistent XLA compilation cache —
+    repeat launches of the same program skip the multi-minute compile.
+  * ThroughputReport: steps/s, pixel-iters/s (the tokens/s analog for
+    this workload), and MFU from counted FLOPs — the record format
+    scripts/train_bench.py emits per config.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Iterator, Optional
 
 import jax
+
+# default persistent-cache location (train_cli --compile_cache,
+# scripts/train_bench.py); relative to the process CWD like logs/
+DEFAULT_CACHE_DIR = os.path.join("logs", "xla_cache")
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
+    """Turn on XLA's persistent compilation cache at cache_dir.
+
+    Every fresh process pays full XLA compile time for the train step
+    (multi-minute at production geometry); with the cache, the second
+    and later launches deserialize the compiled executable from disk in
+    seconds. The thresholds are zeroed so even sub-second compiles cache
+    — this repo's jitted steps are exactly the artifacts worth keeping.
+    Safe to call more than once; returns the directory used.
+    """
+    cache_dir = cache_dir or DEFAULT_CACHE_DIR
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return cache_dir
+
+
+class ThroughputReport:
+    """Training-throughput record: steps/s, pixel-iters/s, MFU.
+
+    pixel-iters/s = batch * H * W * iters * steps/s — the tokens/s
+    analog for iterative-refinement optical flow (each refinement
+    iteration touches every pixel once, like a decode step touches
+    every position). MFU = counted_flops / step_time / chip_peak, with
+    both inputs named in the record (docs/perf.md "MFU accounting").
+    """
+
+    def __init__(self, *, batch: int, height: int, width: int, iters: int):
+        self.batch = batch
+        self.height = height
+        self.width = width
+        self.iters = iters
+
+    def fields(self, step_s: float, flops: Optional[int] = None,
+               peak_flops: Optional[float] = None) -> dict:
+        out = {
+            "step_ms": round(step_s * 1e3, 2),
+            "steps_per_sec": round(1.0 / step_s, 3),
+            "pixel_iters_per_sec": round(
+                self.batch * self.height * self.width * self.iters / step_s),
+        }
+        if flops:
+            out["step_flops"] = int(flops)
+            out["tflops_per_sec"] = round(flops / step_s / 1e12, 2)
+            if peak_flops:
+                out["mfu"] = round(flops / step_s / peak_flops, 4)
+                out["chip_peak_bf16_flops"] = int(peak_flops)
+        return out
 
 
 @contextlib.contextmanager

@@ -21,6 +21,13 @@ from dexiraft_tpu.models.raft import RAFT
 
 @flax.struct.dataclass
 class TrainState:
+    """Dtype contract: `params`, `opt_state`, and `batch_stats` are fp32
+    REGARDLESS of TrainConfig.precision — under the bf16 policy the model
+    runs its mixed-precision path and flax casts per-op bf16 copies from
+    the fp32 masters here, which are what the optimizer updates and
+    checkpoints serialize. Checkpoints are therefore precision-portable:
+    a run can switch policy on resume."""
+
     step: jax.Array  # scalar int32
     params: Any
     batch_stats: Any  # BatchNorm running stats ({} when encoders have none)

@@ -26,10 +26,9 @@ from dexiraft_tpu.config import RAFTConfig, TrainConfig
 from dexiraft_tpu.models.raft import RAFT
 from dexiraft_tpu.ops.losses import sequence_loss
 from dexiraft_tpu.parallel.mesh import (
-    SEQ_AXIS,
-    batch_sharding,
+    DATA_AXIS,
+    batch_input_sharding,
     replicated_sharding,
-    spatial_sharding,
 )
 from dexiraft_tpu.train.optimizer import training_schedule
 from dexiraft_tpu.train.state import TrainState, make_optimizer_from
@@ -64,6 +63,14 @@ def _add_noise(rng: jax.Array, stdv: jax.Array, image: jax.Array) -> jax.Array:
     return jnp.clip(noisy, 0.0, 255.0)
 
 
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating leaf of a pytree to dtype; leave the rest alone."""
+    def cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+    return jax.tree.map(cast, tree)
+
+
 def make_train_step(
     cfg: RAFTConfig,
     tc: TrainConfig,
@@ -71,6 +78,25 @@ def make_train_step(
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step. With a mesh, in/out shardings pin the
     batch to the 'data' axis and everything else replicated."""
+    if tc.precision not in ("fp32", "bf16"):
+        raise ValueError(f"precision must be fp32|bf16, got {tc.precision!r}")
+    if tc.accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {tc.accum_steps}")
+    # bf16 training policy: force the MODEL's mixed-precision path —
+    # module compute dtype becomes bf16, so flax casts each op's params
+    # from the fp32 masters per use (autodiff transposes the casts and
+    # the gradients land back fp32), activations are genuinely bf16, and
+    # the corr volume stays fp32 by the model's own mixed-precision
+    # contract. Everything after the model — loss, metrics, BN running
+    # stats, optimizer — stays fp32. No loss scaling: bf16 shares fp32's
+    # exponent range (README design note). NOTE a hand-cast of params /
+    # inputs here would NOT work: RAFT.__call__ re-casts inputs fp32 and
+    # derives its compute dtype from cfg.mixed_precision alone.
+    bf16 = tc.precision == "bf16"
+    if bf16 and not cfg.mixed_precision:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, mixed_precision=True)
     model = RAFT(cfg)
     if tc.edge_sum_fusion and (cfg.variant != "raft" or cfg.embed_dexined):
         raise ValueError(
@@ -79,7 +105,7 @@ def make_train_step(
     tx = make_optimizer_from(tc)
     schedule = training_schedule(tc.lr, tc.num_steps)
 
-    def loss_fn(params: Any, state: TrainState, batch: Batch, rng: jax.Array):
+    def loss_fn(params: Any, batch_stats: Any, batch: Batch, rng: jax.Array):
         def fwd(stats, drop_rng, im1, im2, **kw):
             return model.apply(
                 {"params": params, "batch_stats": stats},
@@ -98,9 +124,9 @@ def make_train_step(
             # sequentially, and each pass draws independent dropout masks
             # like the reference's two separate forward calls
             rng_img, rng_edge = jax.random.split(rng)
-            img_flow, mut1 = fwd(state.batch_stats, rng_img,
+            img_flow, mut1 = fwd(batch_stats, rng_img,
                                  batch["image1"], batch["image2"])
-            edge_flow, mut2 = fwd(mut1.get("batch_stats", state.batch_stats),
+            edge_flow, mut2 = fwd(mut1.get("batch_stats", batch_stats),
                                   rng_edge,
                                   batch["edges1"], batch["edges2"])
             outputs = img_flow + edge_flow
@@ -109,10 +135,18 @@ def make_train_step(
             kwargs: Dict[str, Any] = {}
             if "edges1" in batch:
                 kwargs = dict(edges1=batch["edges1"], edges2=batch["edges2"])
-            outputs, mutated = fwd(state.batch_stats, rng, batch["image1"],
+            outputs, mutated = fwd(batch_stats, rng, batch["image1"],
                                    batch["image2"], **kwargs)
+        new_stats = mutated.get("batch_stats", batch_stats)
+        if bf16:
+            # fp32 loss/metrics and fp32 carried state, whatever dtype
+            # the bf16 forward emitted
+            outputs = outputs.astype(jnp.float32)
+            new_stats = cast_floating(new_stats, jnp.float32)
         loss, metrics = sequence_loss(outputs, batch["flow"], batch["valid"], tc.gamma)
-        return loss, (metrics, mutated.get("batch_stats", state.batch_stats))
+        return loss, (metrics, new_stats)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def step(state: TrainState, batch: Batch):
         rng, noise_rng, dropout_rng = jax.random.split(state.rng, 3)
@@ -123,10 +157,57 @@ def make_train_step(
             batch["image1"] = _add_noise(k1, stdv, batch["image1"])
             batch["image2"] = _add_noise(k2, stdv, batch["image2"])
 
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (metrics, batch_stats)), grads = grad_fn(
-            state.params, state, batch, dropout_rng
-        )
+        accum = tc.accum_steps
+        if accum > 1:
+            # gradient accumulation: scan over microbatches INSIDE the
+            # jitted step, so a large effective batch fits one chip and
+            # the accumulation loop compiles once. The batch's leading
+            # dim is (accum * micro); per-microbatch mean grads average
+            # to exactly the full-batch mean grad FOR BN-FREE VARIANTS
+            # (small RAFT — pinned by test). With BatchNorm in train
+            # mode each microbatch normalizes over micro samples, not
+            # the full batch (the usual accumulation caveat, same as
+            # every framework's; equivalent to training at the smaller
+            # BN batch). Running stats thread sequentially through the
+            # scan carry, like sequential steps would
+            b = batch["image1"].shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum}")
+            if mesh is not None:
+                # each microbatch must still split over the data axis,
+                # or GSPMD reshards / idles chips on EVERY scan
+                # iteration — the opposite of what accumulation buys
+                n_data = dict(mesh.shape).get(DATA_AXIS, 1)
+                if (b // accum) % n_data:
+                    raise ValueError(
+                        f"microbatch {b // accum} (batch {b} / accum "
+                        f"{accum}) not divisible by the mesh's "
+                        f"{n_data}-way data axis — every chip must "
+                        f"keep a full shard per scan iteration")
+            micro = jax.tree.map(
+                lambda x: x.reshape((accum, b // accum) + x.shape[1:]),
+                batch)
+            rngs = jax.random.split(dropout_rng, accum)
+
+            def body(carry, xs):
+                stats, acc = carry
+                mb, r = xs
+                (mb_loss, (mb_metrics, stats)), grads = grad_fn(
+                    state.params, stats, mb, r)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return (stats, acc), (mb_loss, mb_metrics)
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (batch_stats, gsum), (losses, seq_metrics) = jax.lax.scan(
+                body, (state.batch_stats, zeros), (micro, rngs))
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            loss = jnp.mean(losses)
+            metrics = jax.tree.map(jnp.mean, seq_metrics)
+        else:
+            (loss, (metrics, batch_stats)), grads = grad_fn(
+                state.params, state.batch_stats, batch, dropout_rng)
+
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: p + u, state.params, updates)
 
@@ -149,9 +230,10 @@ def make_train_step(
     # 2-D (data, seq) mesh: image rows additionally shard over 'seq' —
     # GSPMD partitions the convs (halo exchange) and the correlation
     # volume's query axis (context parallelism); every batch leaf is >=3D
-    # (B, H, ...), so one spec covers the dict
-    data = (spatial_sharding(mesh) if SEQ_AXIS in mesh.axis_names
-            else batch_sharding(mesh))
+    # (B, H, ...), so one spec covers the dict. batch_input_sharding is
+    # the same helper the device prefetcher puts with, so prefetched
+    # batches arrive already in this layout
+    data = batch_input_sharding(mesh)
     return jax.jit(
         step,
         in_shardings=(repl, data),

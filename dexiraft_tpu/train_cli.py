@@ -74,6 +74,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip", type=float, default=1.0)
     p.add_argument("--iters", type=int, default=12)
     p.add_argument("--add_noise", action="store_true")
+    p.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
+                   help="training precision policy: bf16 = bf16 "
+                        "compute/activations with fp32 master weights "
+                        "and fp32 loss/optimizer math")
+    p.add_argument("--accum_steps", type=int, default=1,
+                   help="gradient accumulation: batch_size = accum * "
+                        "microbatch; the microbatches run as a lax.scan "
+                        "inside the ONE jitted step")
+    p.add_argument("--prefetch_depth", type=int, default=2,
+                   help="device-side prefetch depth (batches device_put "
+                        "ahead with the step's input shardings while the "
+                        "current step runs; 0 disables)")
+    p.add_argument("--compile_cache", action="store_true",
+                   help="persistent XLA compilation cache — repeat "
+                        "launches skip the multi-minute compile")
+    p.add_argument("--compile_cache_dir", default=None,
+                   help="cache location (default logs/xla_cache); "
+                        "implies --compile_cache")
     p.add_argument("--validation", nargs="*", default=None,
                    choices=sorted(_VAL_ITERS),
                    help="default: the preset's per-stage validation sets")
@@ -150,6 +168,9 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         clip=args.clip,
         iters=args.iters,
         add_noise=args.add_noise,
+        precision=args.precision,
+        accum_steps=args.accum_steps,
+        prefetch_depth=args.prefetch_depth,
         edge_sum_fusion=args.edge_sum_fusion,
         # freeze BN for every post-chairs stage (train.py:149-150)
         freeze_bn=args.stage != "chairs",
@@ -195,7 +216,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
 
     from dexiraft_tpu.data.datasets import fetch_dataset
     from dexiraft_tpu.data.loader import Loader
-    from dexiraft_tpu.parallel.mesh import make_mesh, shard_batch
+    from dexiraft_tpu.data.prefetch import prefetch_to_device
+    from dexiraft_tpu.parallel.mesh import make_mesh
     from dexiraft_tpu.train import checkpoint as ckpt
     from dexiraft_tpu.train.logger import Logger
     from dexiraft_tpu.train.state import create_state, param_count
@@ -203,6 +225,12 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
 
     np.random.seed(tc.seed)
     ckpt_dir = osp.join(args.output, tc.name)
+
+    if args.compile_cache or args.compile_cache_dir:
+        from dexiraft_tpu.profiling import enable_persistent_cache
+
+        print(f"[cache] persistent XLA compile cache: "
+              f"{enable_persistent_cache(args.compile_cache_dir)}")
 
     # the batch shards over the data axis, so the mesh takes the largest
     # device count that divides it (a 10-batch on 8 chips uses 2 — pick
@@ -256,64 +284,75 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     total_steps = int(state.step)
     guard = DivergenceGuard(args.guard_threshold, args.max_rollbacks)
     metrics = None
-    with mesh:
-        for batch in loader:
-            # range-based (not equality) so resumed runs landing inside
-            # the window still profile, and stop only pairs with a start
-            if (not prof_active and prof_start <= total_steps < prof_stop):
-                jax.profiler.start_trace(prof_dir)
-                prof_active = True
-            state, metrics = step_fn(state, shard_batch(batch, mesh))
-            total_steps += 1
-            logger.push(metrics)
-            if prof_active and total_steps >= prof_stop:
-                jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
-                prof_active = False
-                print(f"[profile] trace -> {prof_dir}")
+    # device-side double buffering: batch N+1 is device_put with the
+    # step's input shardings while step N runs — the synchronous
+    # host->device hop leaves the critical path (data/prefetch.py)
+    batches = prefetch_to_device(loader, mesh, depth=tc.prefetch_depth)
+    try:
+        with mesh:
+            for batch in batches:
+                # range-based (not equality) so resumed runs landing inside
+                # the window still profile, and stop only pairs with a start
+                if (not prof_active and prof_start <= total_steps < prof_stop):
+                    jax.profiler.start_trace(prof_dir)
+                    prof_active = True
+                state, metrics = step_fn(state, batch)
+                total_steps += 1
+                logger.push(metrics)
+                if prof_active and total_steps >= prof_stop:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    prof_active = False
+                    print(f"[profile] trace -> {prof_dir}")
 
-            # divergence guard: checked on its own cadence AND before
-            # every checkpoint write, so a poisoned state is never saved
-            if not args.no_guard and (
-                    total_steps % args.guard_every == 0
-                    or total_steps % tc.val_freq == 0):
-                loss_v = float(jax.device_get(metrics["loss"]))
-                # state_finite is the step's POST-update verdict — the
-                # loss alone certifies only the PRE-update params, not
-                # the state the checkpoint below would save
-                state_ok = bool(jax.device_get(
-                    metrics.get("state_finite", True)))
-                if guard.poisoned(loss_v, state_ok):
-                    guard.consume_rollback(loss_v, state_ok,
-                                           f"step {total_steps}",
-                                           last_saved)
-                    state = ckpt.restore_checkpoint(ckpt_dir, state,
-                                                    step=last_saved)
-                    # the restored state has no fresh metrics; leaving
-                    # the poisoned step's here would make the END-OF-RUN
-                    # guard below veto the final save of a GOOD state
-                    metrics = None
-                    print(f"[guard] loss {loss_v:.4g} "
-                          f"(state_finite={state_ok}) at step "
-                          f"{total_steps}; restored step {last_saved} "
-                          f"(rollback {guard.rollbacks}/"
-                          f"{args.max_rollbacks})")
-                    # relative rewind: the logger's counter is per-run
-                    # (starts at 0 on resume), so subtract the rolled-
-                    # back window rather than assigning the global step
-                    logger.rewind(logger.total_steps
-                                  - (total_steps - last_saved))
-                    total_steps = last_saved
-                    continue  # never checkpoint on a rollback step
+                # divergence guard: checked on its own cadence AND before
+                # every checkpoint write, so a poisoned state is never saved
+                if not args.no_guard and (
+                        total_steps % args.guard_every == 0
+                        or total_steps % tc.val_freq == 0):
+                    loss_v = float(jax.device_get(metrics["loss"]))
+                    # state_finite is the step's POST-update verdict — the
+                    # loss alone certifies only the PRE-update params, not
+                    # the state the checkpoint below would save
+                    state_ok = bool(jax.device_get(
+                        metrics.get("state_finite", True)))
+                    if guard.poisoned(loss_v, state_ok):
+                        guard.consume_rollback(loss_v, state_ok,
+                                               f"step {total_steps}",
+                                               last_saved)
+                        state = ckpt.restore_checkpoint(ckpt_dir, state,
+                                                        step=last_saved)
+                        # the restored state has no fresh metrics; leaving
+                        # the poisoned step's here would make the END-OF-RUN
+                        # guard below veto the final save of a GOOD state
+                        metrics = None
+                        print(f"[guard] loss {loss_v:.4g} "
+                              f"(state_finite={state_ok}) at step "
+                              f"{total_steps}; restored step {last_saved} "
+                              f"(rollback {guard.rollbacks}/"
+                              f"{args.max_rollbacks})")
+                        # relative rewind: the logger's counter is per-run
+                        # (starts at 0 on resume), so subtract the rolled-
+                        # back window rather than assigning the global step
+                        logger.rewind(logger.total_steps
+                                      - (total_steps - last_saved))
+                        total_steps = last_saved
+                        continue  # never checkpoint on a rollback step
 
-            if total_steps % tc.val_freq == 0:
-                ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
-                last_saved = total_steps
-                for vname in tc.validation:
-                    logger.write_dict(validate(vname), step=total_steps)
-            if total_steps >= tc.num_steps:
-                break
-
+                if total_steps % tc.val_freq == 0:
+                    ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
+                    last_saved = total_steps
+                    for vname in tc.validation:
+                        logger.write_dict(validate(vname), step=total_steps)
+                if total_steps >= tc.num_steps:
+                    break
+    finally:
+        # stop the host pipeline — on the happy path AND when the loop
+        # dies (interrupt, OOM, failed restore): the Loader's feeder
+        # thread / worker pool must not outlive the loop, and the
+        # in-flight prefetched device batches have no work left to do
+        # while validation and the final save run below
+        batches.close()
     if prof_active:  # window extended past the last step: finalize
         jax.profiler.stop_trace()
         print(f"[profile] trace (truncated at end of run) -> {prof_dir}")
@@ -333,6 +372,7 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     if final_ok:
         ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
     logger.close()
+    print(f"[prefetch] {batches.stats.summary()}")
     print(f"Done: {total_steps} steps -> {ckpt_dir}")
 
 

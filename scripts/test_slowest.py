@@ -1,0 +1,47 @@
+"""Print the slowest tests from the last recorded tier-1 run.
+
+tests/conftest.py rewrites logs/test_durations.json after every test
+(so a session killed at the 870 s tier-1 cap still leaves the completed
+prefix). This prints the top offenders — the tests to mark `slow` or
+cheapen when the budget guard (DEXIRAFT_TEST_CEILING_S) starts
+complaining.
+
+Usage: python scripts/test_slowest.py [-n 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os.path as osp
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=10, help="how many to print")
+    args = ap.parse_args()
+
+    path = osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
+                    "logs", "test_durations.json")
+    try:
+        with open(path) as f:
+            durations = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"no recorded run ({path}: {e}); run the suite first",
+              file=sys.stderr)
+        return 1
+
+    ranked = sorted(durations.items(), key=lambda kv: -kv[1])
+    total = sum(durations.values())
+    print(f"{len(durations)} recorded tests, {total:.1f}s total "
+          f"(setup+call+teardown; tier-1 budget 870s); "
+          f"top {min(args.n, len(ranked))}:")
+    for nodeid, dur in ranked[: args.n]:
+        pct = f"{100 * dur / total:4.1f}%" if total > 0 else "   —"
+        print(f"  {dur:7.2f}s  {pct}  {nodeid}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
