@@ -1,27 +1,51 @@
 """Eval-time padding to stride-8 shapes (core/utils/utils.py:7-24).
 
-'sintel' mode centers the pad; other modes (kitti/HD1K) pad top+right only
-— replicate-edge padding in both, like F.pad(mode='replicate').
+'sintel' mode centers the pad; other modes (kitti/HD1K) pad width
+centered + all height at the bottom — replicate-edge padding in both,
+like F.pad(mode='replicate').
+
+`target=` generalizes the reference contract for the serving engine's
+shape buckets (dexiraft_tpu.serve): instead of the next stride multiple,
+pad out to an arbitrary (stride-aligned, >= input) bucket shape with the
+same replicate-edge placement rules, and unpad per item on the way out.
+target=None is bit-for-bit the reference behavior.
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
 
 class InputPadder:
-    def __init__(self, shape: Sequence[int], mode: str = "sintel", stride: int = 8):
+    def __init__(self, shape: Sequence[int], mode: str = "sintel", stride: int = 8,
+                 target: Optional[Tuple[int, int]] = None):
         self.ht, self.wd = int(shape[-3]), int(shape[-2])  # NHWC
-        pad_ht = (((self.ht // stride) + 1) * stride - self.ht) % stride
-        pad_wd = (((self.wd // stride) + 1) * stride - self.wd) % stride
+        if target is None:
+            pad_ht = (((self.ht // stride) + 1) * stride - self.ht) % stride
+            pad_wd = (((self.wd // stride) + 1) * stride - self.wd) % stride
+        else:
+            tht, twd = int(target[0]), int(target[1])
+            if tht < self.ht or twd < self.wd:
+                raise ValueError(
+                    f"pad target {tht}x{twd} smaller than input "
+                    f"{self.ht}x{self.wd}")
+            if tht % stride or twd % stride:
+                raise ValueError(
+                    f"pad target {tht}x{twd} not stride-{stride} aligned")
+            pad_ht, pad_wd = tht - self.ht, twd - self.wd
         if mode == "sintel":
             # [left, right, top, bottom]
             self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
                          pad_ht // 2, pad_ht - pad_ht // 2]
         else:
             self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
+
+    @property
+    def padded_shape(self) -> Tuple[int, int]:
+        l, r, t, b = self._pad
+        return (self.ht + t + b, self.wd + l + r)
 
     def pad(self, *inputs: np.ndarray) -> Tuple[np.ndarray, ...]:
         l, r, t, b = self._pad

@@ -7,13 +7,23 @@ griddata on host, a device round-trip per frame), propagation runs
 on-device (dexiraft_tpu.eval.interpolate).
 
 KITTI: per-frame 16-bit PNG encoding.
+
+Batching (`batch_size>1`): KITTI frames are independent and stream
+through the inference engine (dexiraft_tpu.serve) like a validation
+set. Sintel's warm start is sequential WITHIN a sequence but
+independent ACROSS sequences, so the batched path runs `batch_size`
+sequences abreast: position j of each sequence rides one batch, and
+each row carries ITS OWN flow_init (the engine materializes zeros for
+rows whose sequence just started or already ended — numerically the
+cold start). Frame j+1 still waits for frame j's flow_low, but the
+forward now amortizes its prelude over a whole batch of sequences.
 """
 
 from __future__ import annotations
 
 import os
 import os.path as osp
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -24,21 +34,92 @@ from dexiraft_tpu.eval.interpolate import forward_interpolate
 EvalFn = Callable[..., Tuple[np.ndarray, np.ndarray]]
 
 
+def _write_sintel(output_path: str, dstype: str, sequence: str,
+                  frame: int, flow: np.ndarray) -> None:
+    out_dir = osp.join(output_path, dstype, sequence)
+    os.makedirs(out_dir, exist_ok=True)
+    write_flo(osp.join(out_dir, f"frame{frame + 1:04d}.flo"), flow)
+
+
+def _sequence_indices(ds) -> "Dict[str, List[int]]":
+    """Dataset index lists per Sintel sequence, in frame order. Reads
+    the dataset's extra_info table (never decodes images)."""
+    seqs: Dict[str, List[int]] = {}
+    for i, (sequence, _frame) in enumerate(ds.extra_info):
+        seqs.setdefault(sequence, []).append(i)
+    return seqs
+
+
+def _sintel_batched(eval_fn: EvalFn, ds, dstype: str, output_path: str,
+                    warm_start: bool, batch_size: int, engine=None) -> None:
+    """`batch_size` sequences abreast with per-item flow_init carry."""
+    from dexiraft_tpu.serve import InferenceEngine, ServeConfig
+
+    if engine is None:
+        engine = InferenceEngine(
+            eval_fn, ServeConfig(batch_size=batch_size, mode="sintel",
+                                 warm_start=warm_start))
+    if not warm_start:
+        # no carry -> frames are independent; the fully pipelined
+        # stream() path (async in-flight dispatch) beats the
+        # position-synchronous loop below
+        def samples():
+            for i in range(len(ds)):
+                s = ds.sample(i)
+                yield {"image1": s["image1"], "image2": s["image2"],
+                       "extra_info": s["extra_info"]}
+
+        for r in engine.stream(samples(), mode="sintel"):
+            sequence, frame = r.item["extra_info"]
+            _write_sintel(output_path, dstype, sequence, frame, r.flow_up)
+        return
+    batch_size = engine.config.batch_size  # group sequences to its shape
+    seqs = list(_sequence_indices(ds).items())
+    for g in range(0, len(seqs), batch_size):
+        group = seqs[g:g + batch_size]
+        carry: Dict[str, Optional[np.ndarray]] = {s: None for s, _ in group}
+        for pos in range(max(len(idxs) for _, idxs in group)):
+            items, names = [], []
+            for sequence, idxs in group:
+                if pos >= len(idxs):
+                    continue  # this sequence already ended; row drops out
+                s = ds.sample(idxs[pos])
+                items.append({"image1": s["image1"], "image2": s["image2"],
+                              "flow_init": carry[sequence],
+                              "extra_info": s["extra_info"]})
+                names.append(sequence)
+            for sequence, r in zip(names, engine.run_batch(items)):
+                _, frame = r.item["extra_info"]
+                _write_sintel(output_path, dstype, sequence, frame, r.flow_up)
+                carry[sequence] = np.asarray(forward_interpolate(r.flow_low))
+
+
 def create_sintel_submission(
     eval_fn: EvalFn,
     output_path: str = "sintel_submission",
     warm_start: bool = False,
     datasets=None,
+    batch_size: int = 1,
+    engine=None,
 ) -> None:
     """Write .flo predictions for the Sintel test split (evaluate.py:22-54).
 
     eval_fn(image1, image2, flow_init=...) -> (flow_low, flow_up), jitted
-    with iters=32.
+    with iters=32. batch_size>1 (or a caller-built engine, e.g. a
+    data-parallel one) runs sequences abreast through the serving engine
+    (module docstring) and needs a dataset exposing `extra_info`
+    (FlowDataset does).
     """
     if datasets is None:
         from dexiraft_tpu.data.datasets import MpiSintel
         datasets = {d: MpiSintel(None, split="test", dstype=d)
                     for d in ("clean", "final")}
+
+    if batch_size > 1 or engine is not None:
+        for dstype, ds in datasets.items():
+            _sintel_batched(eval_fn, ds, dstype, output_path,
+                            warm_start, batch_size, engine=engine)
+        return
 
     for dstype, ds in datasets.items():
         flow_prev, sequence_prev = None, None
@@ -56,9 +137,7 @@ def create_sintel_submission(
             if warm_start:
                 flow_prev = np.asarray(forward_interpolate(flow_low[0]))[None]
 
-            out_dir = osp.join(output_path, dstype, sequence)
-            os.makedirs(out_dir, exist_ok=True)
-            write_flo(osp.join(out_dir, f"frame{frame + 1:04d}.flo"), flow)
+            _write_sintel(output_path, dstype, sequence, frame, flow)
             sequence_prev = sequence
 
 
@@ -66,13 +145,29 @@ def create_kitti_submission(
     eval_fn: EvalFn,
     output_path: str = "kitti_submission",
     dataset=None,
+    batch_size: int = 1,
+    engine=None,
 ) -> None:
     """Write 16-bit PNG predictions for the KITTI test split
-    (evaluate.py:58-77); eval_fn jitted with iters=24."""
+    (evaluate.py:58-77); eval_fn jitted with iters=24. batch_size>1
+    streams the independent frames through the serving engine."""
     if dataset is None:
         from dexiraft_tpu.data.datasets import KITTI
         dataset = KITTI(None, split="testing")
     os.makedirs(output_path, exist_ok=True)
+
+    if batch_size > 1 or engine is not None:
+        if engine is None:
+            from dexiraft_tpu.serve import InferenceEngine, ServeConfig
+
+            engine = InferenceEngine(
+                eval_fn, ServeConfig(batch_size=batch_size, mode="kitti"))
+        samples = (dataset.sample(i) for i in range(len(dataset)))
+        for r in engine.stream(samples, mode="kitti"):
+            (frame_id,) = r.item["extra_info"]
+            write_flow_kitti(osp.join(output_path, frame_id), r.flow_up)
+        return
+
     for i in range(len(dataset)):
         s = dataset.sample(i)
         (frame_id,) = s["extra_info"]

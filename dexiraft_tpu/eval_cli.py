@@ -45,6 +45,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(numerically identical; see docs/perf.md)")
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--output", default=None, help="submission output dir")
+    p.add_argument("--batch_size", type=int, default=1,
+                   help="frame pairs per forward: 1 = the reference "
+                        "per-image loop; >1 streams the dataset through "
+                        "the throughput-mode inference engine "
+                        "(dexiraft_tpu.serve) with identical metrics")
+    p.add_argument("--serve", action="store_true",
+                   help="route through the inference engine even at "
+                        "batch_size 1 (async in-flight dispatch, bucket "
+                        "accounting)")
+    p.add_argument("--inflight", type=int, default=2,
+                   help="dispatched-unfetched batches the engine holds "
+                        "before blocking on a host fetch")
+    p.add_argument("--bucket_multiple", type=int, default=None,
+                   help="quantize pad shapes up to multiples of this "
+                        "(bounds compiled executables across mixed "
+                        "geometries; default = stride 8, the exact "
+                        "reference pad shapes)")
+    p.add_argument("--data_parallel", type=int, default=0,
+                   help="shard each inference batch over this many "
+                        "chips (0 = single chip); batch_size must "
+                        "divide by it")
     return p
 
 
@@ -74,12 +95,52 @@ def _edgesum_dataset(edge_root: str):
                                edge_root)
 
 
+def _serving(args) -> bool:
+    return args.serve or args.batch_size > 1 or args.data_parallel > 0
+
+
+def _make_eval_fn(args, cfg, variables, iters):
+    """Uniform eval-fn: (im1, im2, flow_init) — POSITIONAL-safe for the
+    engine (the mesh path pins in_shardings, which rejects kwargs) and
+    kwarg-friendly for the per-image loops. Sintel and KITTI now share
+    one signature: flow_init=None is always accepted (the KITTI model
+    simply never receives a warm start)."""
+    from dexiraft_tpu.train.step import make_eval_step
+
+    mesh = None
+    if args.data_parallel > 0:
+        from dexiraft_tpu.parallel.mesh import make_serve_mesh, replicate
+
+        mesh = make_serve_mesh(args.data_parallel)
+        # replicate once up front — the pinned replicated in_sharding
+        # would otherwise re-transfer the params on every dispatch
+        variables = replicate(variables, mesh)
+    step = make_eval_step(cfg, iters=iters, mesh=mesh)
+    if mesh is None:
+        return (lambda im1, im2, flow_init=None:
+                step(variables, im1, im2, flow_init=flow_init)), None
+    return (lambda im1, im2, flow_init=None:
+            step(variables, im1, im2, None, None, flow_init)), mesh
+
+
+def _make_engine(args, eval_fn, mesh, mode, warm_start=False):
+    from dexiraft_tpu.serve import InferenceEngine, ServeConfig
+
+    return InferenceEngine(
+        eval_fn,
+        ServeConfig(batch_size=args.batch_size, mode=mode,
+                    bucket_multiple=args.bucket_multiple,
+                    inflight=args.inflight, warm_start=warm_start),
+        mesh=mesh)
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if not args.dataset and not args.submission:
         raise SystemExit("need --dataset or --submission")
-
-    from dexiraft_tpu.train.step import make_eval_step
+    if args.data_parallel and args.batch_size % max(args.data_parallel, 1):
+        raise SystemExit(f"--batch_size {args.batch_size} must divide by "
+                         f"--data_parallel {args.data_parallel}")
 
     cfg, variables = load_variables(args)
 
@@ -93,29 +154,40 @@ def main(argv=None) -> None:
             dataset = _edgesum_dataset(args.edge_root)
 
         iters = args.iters or _VAL_ITERS.get(args.dataset, 24)
-        step = make_eval_step(cfg, iters=iters)
-        run_validation(
-            args.dataset,
-            lambda im1, im2, flow_init=None: step(variables, im1, im2,
-                                                  flow_init=flow_init),
-            dataset)
+        eval_fn, mesh = _make_eval_fn(args, cfg, variables, iters)
+        engine = None
+        if _serving(args):
+            mode = "kitti" if args.dataset in ("kitti", "hd1k") else "sintel"
+            engine = _make_engine(args, eval_fn, mesh, mode)
+        run_validation(args.dataset, eval_fn, dataset,
+                       batch_size=args.batch_size, engine=engine)
+        if engine is not None:
+            print(f"engine: {engine.stats.summary()}")
 
     if args.submission == "sintel":
         from dexiraft_tpu.eval.submission import create_sintel_submission
 
-        step = make_eval_step(cfg, iters=args.iters or 32)
+        eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters or 32)
+        engine = (_make_engine(args, eval_fn, mesh, "sintel",
+                               warm_start=args.warm_start)
+                  if _serving(args) else None)
         create_sintel_submission(
-            lambda im1, im2, flow_init=None: step(variables, im1, im2,
-                                                  flow_init=flow_init),
+            eval_fn,
             output_path=args.output or "sintel_submission",
-            warm_start=args.warm_start)
+            warm_start=args.warm_start,
+            batch_size=args.batch_size,
+            engine=engine)
     elif args.submission == "kitti":
         from dexiraft_tpu.eval.submission import create_kitti_submission
 
-        step = make_eval_step(cfg, iters=args.iters or 24)
+        eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters or 24)
+        engine = (_make_engine(args, eval_fn, mesh, "kitti")
+                  if _serving(args) else None)
         create_kitti_submission(
-            lambda im1, im2, flow_init=None: step(variables, im1, im2),
-            output_path=args.output or "kitti_submission")
+            eval_fn,
+            output_path=args.output or "kitti_submission",
+            batch_size=args.batch_size,
+            engine=engine)
 
 
 if __name__ == "__main__":

@@ -52,6 +52,20 @@ def make_mesh_2d(
     return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
 
 
+def make_serve_mesh(n_chips: Optional[int] = None) -> Mesh:
+    """1-D data mesh for the serving engine (dexiraft_tpu.serve): an
+    inference batch shards over the 'data' axis across `n_chips` (default
+    all). Serving never needs the 2-D (data, seq) train mesh — eval
+    batches are the parallelism, not image rows."""
+    devices = jax.devices()
+    if n_chips is not None:
+        if not 1 <= n_chips <= len(devices):
+            raise ValueError(
+                f"n_chips {n_chips} out of range 1..{len(devices)}")
+        devices = devices[:n_chips]
+    return make_mesh(devices)
+
+
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     """Shard the leading (batch) dim over the data axis."""
     return NamedSharding(mesh, P(axis))

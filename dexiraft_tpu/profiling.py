@@ -12,6 +12,9 @@ Tools:
   * ThroughputReport: steps/s, pixel-iters/s (the tokens/s analog for
     this workload), and MFU from counted FLOPs — the record format
     scripts/train_bench.py emits per config.
+  * ServeStats: dispatch/fetch/in-flight accounting for the serving
+    engine (dexiraft_tpu.serve) — the record scripts/serve_bench.py
+    emits per config.
 """
 
 from __future__ import annotations
@@ -77,6 +80,55 @@ class ThroughputReport:
                 out["mfu"] = round(flops / step_s / peak_flops, 4)
                 out["chip_peak_bf16_flops"] = int(peak_flops)
         return out
+
+
+class ServeStats:
+    """Honest dispatch/fetch accounting for the throughput-mode inference
+    engine (dexiraft_tpu.serve.InferenceEngine).
+
+    The engine's dispatch is asynchronous: eval_fn() enqueues device work
+    and returns array FUTURES; the only host-blocking operation is the
+    np.asarray fetch when a ticket leaves the in-flight window. So:
+
+      * fetch_s       — wall time the host spent BLOCKED inside fetches
+                        (device compute the in-flight window failed to
+                        hide; the serving analog of prefetch_stall)
+      * dispatch_s    — host-side pad/stack/put/enqueue time (never
+                        blocks on device compute)
+      * batch_latency — per-batch dispatch→fetch-complete wall time;
+                        p50/p99 come from these samples
+      * peak_inflight — max dispatched-unfetched batches observed
+      * pad_frames    — tail filler items (dispatched for shape
+                        stability, masked out of results)
+    """
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.batches = 0
+        self.frames = 0          # real frame pairs yielded
+        self.pad_frames = 0      # partial-batch tail filler (masked out)
+        self.dispatch_s = 0.0
+        self.fetch_s = 0.0
+        self.fetches = 0
+        self.peak_inflight = 0
+        self.batch_latency_s: list = []
+
+    def latency_ms(self, p: float) -> float:
+        import numpy as np
+
+        if not self.batch_latency_s:
+            return 0.0
+        return float(np.percentile(self.batch_latency_s, p)) * 1e3
+
+    def summary(self) -> str:
+        return (f"{self.batches} batches / {self.frames} frame pairs "
+                f"(+{self.pad_frames} tail pad), peak in-flight "
+                f"{self.peak_inflight}, fetch-blocked "
+                f"{self.fetch_s * 1e3:.1f} ms total, batch latency "
+                f"p50 {self.latency_ms(50):.1f} / "
+                f"p99 {self.latency_ms(99):.1f} ms")
 
 
 @contextlib.contextmanager

@@ -1,0 +1,13 @@
+"""Throughput-mode inference: shape buckets, micro-batching, async
+in-flight dispatch, optional data-parallel serving (ISSUE 3 tentpole)."""
+
+from dexiraft_tpu.serve.buckets import BucketRegistry, bucket_shape
+from dexiraft_tpu.serve.engine import InferenceEngine, Result, ServeConfig
+
+__all__ = [
+    "BucketRegistry",
+    "bucket_shape",
+    "InferenceEngine",
+    "Result",
+    "ServeConfig",
+]

@@ -1,0 +1,68 @@
+"""Shape-bucket registry: arbitrary geometries -> a bounded executable set.
+
+Every distinct padded input shape the jitted eval step sees costs one
+XLA compile (in-process jit cache + the PR 2 persistent disk cache).
+Per-image eval pads each frame to its own next-stride-multiple shape, so
+a mixed-geometry stream (KITTI's per-frame sizes, multi-dataset serving)
+compiles an executable per distinct geometry. The registry quantizes
+geometries UP to multiples of `multiple` (itself a multiple of the
+model's stride-8 contract): frames land in a small set of bucket shapes,
+each bucket compiles exactly once, and the replicate-edge pad out to the
+bucket is undone per item on the way back (data.padder.InputPadder with
+`target=`).
+
+multiple == stride (the default) reproduces the reference pad shapes
+exactly — the parity configuration eval_cli uses; serving deployments
+raise it (e.g. 64) to bound the executable count across datasets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+def bucket_shape(ht: int, wd: int, stride: int = 8,
+                 multiple: Optional[int] = None) -> Tuple[int, int]:
+    """Smallest (H, W) >= input with both dims multiples of `multiple`."""
+    m = multiple or stride
+    if m % stride:
+        raise ValueError(f"bucket multiple {m} must be a multiple of the "
+                         f"model stride {stride}")
+    return (-(-ht // m) * m, -(-wd // m) * m)
+
+
+class BucketRegistry:
+    """Maps input geometries to bucket shapes and counts hits/compiles."""
+
+    def __init__(self, stride: int = 8, multiple: Optional[int] = None):
+        self.stride = stride
+        self.multiple = multiple or stride
+        self.hits: Dict[Tuple[int, int], int] = {}
+        self._compiled: set = set()
+
+    def bucket_for(self, ht: int, wd: int) -> Tuple[int, int]:
+        b = bucket_shape(ht, wd, self.stride, self.multiple)
+        self.hits[b] = self.hits.get(b, 0) + 1
+        return b
+
+    def mark_compiled(self, key) -> bool:
+        """Record a dispatch-signature key (bucket shape + flow_init
+        presence); True the first time = a fresh executable."""
+        if key in self._compiled:
+            return False
+        self._compiled.add(key)
+        return True
+
+    @property
+    def compiles(self) -> int:
+        return len(self._compiled)
+
+    def stats(self) -> dict:
+        return {
+            "stride": self.stride,
+            "multiple": self.multiple,
+            "buckets": {f"{h}x{w}": n
+                        for (h, w), n in sorted(self.hits.items())},
+            "bucket_count": len(self.hits),
+            "compiles": self.compiles,
+        }

@@ -1,0 +1,275 @@
+"""Throughput-mode inference engine over the jitted eval step.
+
+The per-image eval loop (eval/validate.py pre-engine) ran one padded
+frame pair at a time, synchronously: pad -> dispatch -> np.asarray (host
+blocks until the chip finishes) -> unpad, with every distinct geometry
+paying a fresh XLA compile. This engine gives the forward's consumers
+the same treatment PR 2 gave training:
+
+  * shape buckets (serve.buckets): geometries quantize to a bounded set
+    of stride-aligned bucket shapes; one executable per bucket, cached
+    in-process and in the PR 2 persistent XLA cache.
+  * micro-batching: same-bucket frame pairs group into batches of
+    `batch_size`, amortizing the DexiNed prelude / pyramid build exactly
+    like training batches do. The tail batch of a bucket is padded back
+    up to `batch_size` by replicating its last item — shape stability
+    keeps the one-executable-per-bucket contract — and the filler
+    results are masked out, so metrics cover exactly the dataset.
+  * async in-flight dispatch: eval_fn only ENQUEUES device work (jax
+    async dispatch) and the host->device put is async too, so holding
+    `inflight` dispatched tickets before fetching overlaps device
+    compute with host pad/stack/encode work. ServeStats (profiling.py)
+    accounts the residual honestly: fetch_s is the compute the window
+    failed to hide.
+  * data-parallel serving: with a mesh, each batch device_puts sharded
+    over the 'data' axis and the pinned eval step (train.step
+    make_eval_step(mesh=...)) runs it SPMD across chips.
+
+eval_fn contract: eval_fn(image1, image2, flow_init) -> (flow_low,
+flow_up), POSITIONAL (the mesh path pins in_shardings, and jit rejects
+kwargs when shardings are pinned), batched NHWC in [0, 255], flow_init
+either None or a (B, H/8, W/8, 2) array. A flow_init row of ZEROS is
+numerically identical to no warm start (RAFT adds it to coords0), which
+is what makes per-item carry work: one batch can mix warm-started items
+and cold items without a second executable.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dexiraft_tpu.data.padder import InputPadder
+from dexiraft_tpu.profiling import ServeStats
+from dexiraft_tpu.serve.buckets import BucketRegistry
+
+EvalFn = Callable[..., Tuple[Any, Any]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (see module docstring for the design)."""
+
+    batch_size: int = 1
+    mode: str = "sintel"         # pad placement (data.padder modes)
+    stride: int = 8
+    # bucket quantization granule; None -> stride (reference pad shapes,
+    # the metric-parity configuration)
+    bucket_multiple: Optional[int] = None
+    # dispatched-unfetched tickets to hold before blocking on a fetch
+    inflight: int = 2
+    # always materialize flow_init (zeros for cold items) so warm-start
+    # streams keep one executable per bucket instead of two (None vs
+    # array signatures)
+    warm_start: bool = False
+
+    def __post_init__(self):
+        if self.batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
+        if self.inflight < 1:
+            raise ValueError(f"inflight must be >= 1, got {self.inflight}")
+
+
+class Result(NamedTuple):
+    """One frame pair's inference output.
+
+    flow_up is unpadded back to the item's own (H, W, 2); flow_low stays
+    at the bucket's padded 1/8 resolution — it is the warm-start carry,
+    and the next frame of the same sequence pads to the same bucket.
+    """
+
+    index: int
+    item: Dict[str, Any]
+    flow_low: np.ndarray
+    flow_up: np.ndarray
+
+
+class _Ticket(NamedTuple):
+    flow_low: Any             # device array future (B, bh/8, bw/8, 2)
+    flow_up: Any              # device array future (B, bh, bw, 2)
+    entries: List[Tuple[int, Dict[str, Any], InputPadder]]
+    t_dispatch: float
+
+
+class InferenceEngine:
+    """Bucketed, batched, pipelined driver for a jitted eval forward."""
+
+    def __init__(
+        self,
+        eval_fn: EvalFn,
+        config: ServeConfig = ServeConfig(),
+        *,
+        mesh=None,
+        put: Optional[Callable[[Any], Any]] = None,
+    ):
+        self.eval_fn = eval_fn
+        self.config = config
+        self.mesh = mesh
+        if mesh is not None:
+            n_data = int(np.prod(list(mesh.shape.values())))
+            if config.batch_size % n_data:
+                raise ValueError(
+                    f"batch_size {config.batch_size} not divisible by the "
+                    f"mesh's {n_data} devices — every chip needs a full "
+                    f"shard of each dispatched batch")
+        if put is None:
+            from dexiraft_tpu.parallel.mesh import batch_putter
+
+            put = batch_putter(mesh)
+        self.put = put
+        self.registry = BucketRegistry(config.stride, config.bucket_multiple)
+        self.stats = ServeStats()
+        self.compile_s = 0.0  # time inside first-dispatch eval_fn calls
+        self._inflight: "collections.deque[_Ticket]" = collections.deque()
+
+    # ---- dispatch side -------------------------------------------------
+
+    def _dispatch(self, bucket: Tuple[int, int],
+                  group: List[Tuple[int, Dict[str, Any]]],
+                  mode: str) -> None:
+        cfg = self.config
+        t0 = time.perf_counter()
+        padders = [InputPadder(it["image1"].shape, mode=mode,
+                               stride=cfg.stride, target=bucket)
+                   for _, it in group]
+        im1 = [p.pad(np.asarray(it["image1"], np.float32))[0]
+               for p, (_, it) in zip(padders, group)]
+        im2 = [p.pad(np.asarray(it["image2"], np.float32))[0]
+               for p, (_, it) in zip(padders, group)]
+        fill = cfg.batch_size - len(group)
+        if fill:  # tail: replicate the last item up to the batch shape
+            im1 += [im1[-1]] * fill
+            im2 += [im2[-1]] * fill
+            self.stats.pad_frames += fill
+        im1 = np.stack(im1)
+        im2 = np.stack(im2)
+
+        bh, bw = bucket
+        inits = [it.get("flow_init") for _, it in group]
+        fi = None
+        if cfg.warm_start or any(x is not None for x in inits):
+            fi = np.zeros((cfg.batch_size, bh // cfg.stride,
+                           bw // cfg.stride, 2), np.float32)
+            for row, init in enumerate(inits):
+                if init is not None:
+                    fi[row] = np.asarray(init, np.float32)
+
+        im1, im2, fi = self.put((im1, im2, fi))
+        fresh = self.registry.mark_compiled((bucket, fi is not None))
+        t1 = time.perf_counter()
+        flow_low, flow_up = self.eval_fn(im1, im2, fi)
+        t2 = time.perf_counter()
+        if fresh:
+            # the first call on a fresh signature traces+compiles
+            # synchronously before enqueueing — charge that span to
+            # compile_s ONLY, so dispatch_s stays what ServeStats
+            # documents (host pad/stack/put/enqueue time)
+            self.compile_s += t2 - t1
+            self.stats.dispatch_s += t1 - t0
+        else:
+            self.stats.dispatch_s += t2 - t0
+        self.stats.batches += 1
+        self._inflight.append(_Ticket(
+            flow_low, flow_up,
+            [(idx, it, p) for (idx, it), p in zip(group, padders)],
+            t_dispatch=t0))
+        self.stats.peak_inflight = max(self.stats.peak_inflight,
+                                       len(self._inflight))
+
+    # ---- fetch side ----------------------------------------------------
+
+    def _fetch_one(self) -> Iterator[Result]:
+        ticket = self._inflight.popleft()
+        t0 = time.perf_counter()
+        low = np.asarray(ticket.flow_low)
+        up = np.asarray(ticket.flow_up)
+        now = time.perf_counter()
+        self.stats.fetch_s += now - t0
+        self.stats.fetches += 1
+        self.stats.batch_latency_s.append(now - ticket.t_dispatch)
+        for row, (idx, item, padder) in enumerate(ticket.entries):
+            self.stats.frames += 1
+            yield Result(idx, item, low[row], padder.unpad(up[row]))
+
+    def _drain_to(self, n: int) -> Iterator[Result]:
+        while len(self._inflight) > n:
+            yield from self._fetch_one()
+
+    # ---- public API ----------------------------------------------------
+
+    def stream(self, items: Iterable[Dict[str, Any]],
+               mode: Optional[str] = None) -> Iterator[Result]:
+        """Run every item through the engine; yield Results as their
+        batches complete (bucket-grouped, NOT input order — each Result
+        carries its original index).
+
+        items: dicts with image1/image2 (H, W, C) and anything else the
+        caller wants back on the Result (gt flow, extra_info, ...);
+        an optional per-item flow_init rides the same dict.
+        """
+        mode = mode or self.config.mode
+        cfg = self.config
+        pending: Dict[Tuple[int, int], List[Tuple[int, Dict[str, Any]]]] = {}
+        for index, item in enumerate(items):
+            h, w = item["image1"].shape[-3], item["image1"].shape[-2]
+            bucket = self.registry.bucket_for(h, w)
+            pending.setdefault(bucket, []).append((index, item))
+            if len(pending[bucket]) == cfg.batch_size:
+                # fetch down to a free slot BEFORE dispatching, so at
+                # most `inflight` tickets are ever outstanding
+                yield from self._drain_to(cfg.inflight - 1)
+                self._dispatch(bucket, pending.pop(bucket), mode)
+        for bucket in sorted(pending):  # partial tails, deterministic order
+            yield from self._drain_to(cfg.inflight - 1)
+            self._dispatch(bucket, pending.pop(bucket), mode)
+        yield from self._drain_to(0)
+
+    def run_batch(self, items: List[Dict[str, Any]],
+                  mode: Optional[str] = None) -> List[Result]:
+        """Dispatch ONE batch synchronously and return Results in input
+        order — the building block for sequenced workloads (Sintel
+        warm-start carries the previous frame's flow_low, so frame j+1
+        cannot dispatch before frame j fetches). All items must share a
+        bucket; len(items) <= batch_size (the tail pad fills the rest).
+        """
+        if not items:
+            return []
+        if len(items) > self.config.batch_size:
+            raise ValueError(f"{len(items)} items > batch_size "
+                             f"{self.config.batch_size}")
+        mode = mode or self.config.mode
+        buckets = {self.registry.bucket_for(
+            it["image1"].shape[-3], it["image1"].shape[-2]) for it in items}
+        if len(buckets) > 1:
+            raise ValueError(f"run_batch items span buckets {buckets}")
+        if self._inflight:
+            # fetching here would silently discard an unfinished
+            # stream()'s Results — make the misuse loud instead
+            raise RuntimeError(
+                f"run_batch with {len(self._inflight)} ticket(s) still in "
+                "flight from a previous stream(); consume that iterator "
+                "first (or use a separate engine)")
+        self._dispatch(buckets.pop(), list(enumerate(items)), mode)
+        out = sorted(self._fetch_one(), key=lambda r: r.index)
+        return out
+
+    def stats_record(self) -> dict:
+        """Self-describing stats blob for bench records / logs."""
+        return {
+            "batch_size": self.config.batch_size,
+            "inflight": self.config.inflight,
+            "frames": self.stats.frames,
+            "batches": self.stats.batches,
+            "pad_frames": self.stats.pad_frames,
+            "peak_inflight": self.stats.peak_inflight,
+            "fetch_blocked_ms": round(self.stats.fetch_s * 1e3, 2),
+            "dispatch_ms": round(self.stats.dispatch_s * 1e3, 2),
+            "compile_s": round(self.compile_s, 2),
+            "latency_p50_ms": round(self.stats.latency_ms(50), 2),
+            "latency_p99_ms": round(self.stats.latency_ms(99), 2),
+            **self.registry.stats(),
+        }

@@ -249,12 +249,22 @@ def make_eval_step(
 ) -> Callable[..., Tuple[jax.Array, jax.Array]]:
     """Jitted test-mode forward: (flow_low, flow_up) like core/raft.py:194-197.
 
-    flow_init enables warm-start submission inference (evaluate.py:40-44).
-    With a mesh, shard inputs on the caller side (parallel.shard_batch) —
-    jit propagates input shardings, so no in_shardings pinning is needed
-    and optional args (edges, flow_init) stay supported.
+    Batched NHWC inputs throughout — the serving engine
+    (dexiraft_tpu.serve) feeds bucket-padded batches straight in.
+    flow_init enables warm-start inference (evaluate.py:40-44); a
+    flow_init row of zeros equals no warm start (RAFT adds it to
+    coords0), so one batch can carry PER-ITEM warm starts — warm rows
+    next to cold zero rows — which is how the batched Sintel submission
+    threads each sequence's carry through a shared batch.
+
+    With a mesh the step pins its shardings like the train step does:
+    batch args over the 'data' axis, variables replicated, outputs left
+    sharded (the engine's per-item host fetch assembles them; no
+    all-gather on device). Pinned shardings mean the mesh-path step must
+    be called POSITIONALLY with all six arguments (jit rejects kwargs
+    when in_shardings is set) — mesh=None keeps the kwarg-friendly
+    reference behavior.
     """
-    del mesh  # sharding follows the inputs; kept for API symmetry
     model = RAFT(cfg)
 
     def step(
@@ -279,4 +289,14 @@ def make_eval_step(
             **kwargs,
         )
 
-    return jax.jit(step)
+    if mesh is None:
+        return jax.jit(step)
+    repl = replicated_sharding(mesh)
+    data = batch_input_sharding(mesh)
+    # one `data` leaf per batched positional (images, edges, flow_init);
+    # a None optional consumes its sharding entry as an empty pytree
+    return jax.jit(
+        step,
+        in_shardings=(repl, data, data, data, data, data),
+        out_shardings=(data, data),
+    )

@@ -1,0 +1,307 @@
+"""Serving throughput — the eval-side analog of scripts/train_bench.py.
+
+Drives ONE mixed-geometry frame-pair stream through the throughput-mode
+inference engine (dexiraft_tpu.serve) at batch_size=1 (the reference
+per-image behavior) and at --batch, same jitted eval step, and emits ONE
+JSON record: frame-pairs/s per config, p50/p99 batch latency, bucket
+hit/compile counts (the mixed stream must compile EXACTLY once per
+bucket), peak in-flight depth, fetch-blocked time, and FLOPs/MFU from
+XLA's cost analysis. The speedup field is the acceptance signal:
+batched throughput over the batch-1 configuration of the same run.
+
+Watchdog (the bench.py pattern, tests/test_bench_watchdog.py /
+tests/test_zserve_bench.py): the measurement runs in a CHILD process;
+the parent kills it when it goes silent past SERVE_BENCH_STALL_S or
+overruns SERVE_BENCH_HARD_CAP_S and exits 8 — a relay-tunnel death must
+never hang the driver's round-end run. SERVE_BENCH_FAKE_HANG=1 swaps in
+a child that blocks forever (watchdog tests). The parent imports no jax.
+
+Usage: python scripts/serve_bench.py [--variant v1] [--small]
+           [--batch 4] [--iters 4] [--sizes 40x56,44x60,36x52]
+           [--frames 16] [--bucket_multiple 16] [--inflight 2]
+           [--data_parallel 0] [--cpu] [--no_compile_cache]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+STALL_S = 600.0
+HARD_CAP_S = 1500.0
+
+RECORD_KEYS = {  # pinned by tests/test_zserve_bench.py
+    "metric", "platform", "variant", "iters", "sizes", "frames",
+    "bucket_multiple", "configs", "speedup_batched_over_b1",
+}
+CONFIG_KEYS = {
+    "batch_size", "inflight", "frame_pairs_per_sec", "latency_p50_ms",
+    "latency_p99_ms", "bucket_count", "compiles", "buckets",
+    "peak_inflight", "fetch_blocked_ms", "pad_frames", "compile_s",
+    "flops_per_pair", "tflops_per_sec", "mfu",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="v5")
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--batch", type=int, default=4,
+                    help="the batched configuration's micro-batch size")
+    ap.add_argument("--iters", type=int, default=32)
+    ap.add_argument("--sizes", default="440x1024,436x1020,432x1016",
+                    help="comma-separated HxW geometries, cycled over "
+                         "the stream (mixed-geometry bucket proof)")
+    ap.add_argument("--frames", type=int, default=12,
+                    help="frame pairs in the stream")
+    ap.add_argument("--bucket_multiple", type=int, default=64,
+                    help="bucket quantization granule (multiple of 8)")
+    ap.add_argument("--inflight", type=int, default=2)
+    ap.add_argument("--data_parallel", type=int, default=0,
+                    help="shard each batch over this many chips (0 = one)")
+    ap.add_argument("--compile_cache_dir", default=None)
+    ap.add_argument("--no_compile_cache", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (config.update beats the "
+                         "axon site-hook pin)")
+    return ap
+
+
+def _measure() -> None:
+    args = build_parser().parse_args()
+    import jax
+    import numpy as np
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from dexiraft_tpu import config as C
+    from dexiraft_tpu.config import TrainConfig
+    from dexiraft_tpu.profiling import enable_persistent_cache
+    from dexiraft_tpu.serve import InferenceEngine, ServeConfig
+    from dexiraft_tpu.train.state import create_state
+    from dexiraft_tpu.train.step import make_eval_step
+
+    if not args.no_compile_cache:
+        cache_dir = enable_persistent_cache(args.compile_cache_dir)
+        print(f"compile cache: {cache_dir}", file=sys.stderr)
+
+    sizes = [tuple(int(v) for v in s.split("x")) for s in args.sizes.split(",")]
+    cfg = getattr(C, f"raft_{args.variant}")(small=args.small)
+    state = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    mesh = None
+    if args.data_parallel > 0:
+        from dexiraft_tpu.parallel.mesh import make_serve_mesh, replicate
+
+        mesh = make_serve_mesh(args.data_parallel)
+        # params must live replicated on the mesh up front, or the
+        # pinned replicated in_sharding re-transfers them every dispatch
+        variables = replicate(variables, mesh)
+    step = make_eval_step(cfg, iters=args.iters, mesh=mesh)
+    if mesh is None:
+        eval_fn = lambda a, b, fi: step(variables, a, b, flow_init=fi)
+    else:
+        eval_fn = lambda a, b, fi: step(variables, a, b, None, None, fi)
+    print(f"platform={jax.devices()[0].platform} variant={args.variant} "
+          f"small={args.small} iters={args.iters} sizes={args.sizes} "
+          f"frames={args.frames} batch={args.batch} "
+          f"multiple={args.bucket_multiple} dp={args.data_parallel}",
+          file=sys.stderr)
+
+    def stream_items():
+        # pre-decoded, like the Loader hands over: host next() is free,
+        # so any fetch-blocked time is genuinely device-side
+        rng = np.random.default_rng(0)
+        pool = []
+        for k in range(args.frames):
+            h, w = sizes[k % len(sizes)]
+            pool.append({
+                "image1": rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+                "image2": rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+            })
+        return pool
+
+    pool = stream_items()
+
+    def run_config(batch_size: int) -> dict:
+        engine = InferenceEngine(
+            eval_fn,
+            ServeConfig(batch_size=batch_size, mode="sintel",
+                        bucket_multiple=args.bucket_multiple,
+                        inflight=args.inflight),
+            mesh=mesh)
+        # warmup pass compiles every bucket (counted); the timed pass
+        # must ride the in-process executable cache only
+        t0 = time.perf_counter()
+        for _ in engine.stream(dict(it) for it in pool):
+            pass
+        warm_s = time.perf_counter() - t0
+        print(f"[b={batch_size}] warmup {warm_s:.1f}s "
+              f"(compile {engine.compile_s:.1f}s, "
+              f"{engine.registry.compiles} executables)", file=sys.stderr)
+        engine.stats.reset()
+        engine.registry.hits.clear()  # report the TIMED stream's hits
+        # (the compiled-signature set survives: compiles stays honest)
+        t0 = time.perf_counter()
+        n = sum(1 for _ in engine.stream(dict(it) for it in pool))
+        dt = time.perf_counter() - t0
+        print(f"[b={batch_size}] timed {dt * 1e3:.1f} ms for {n} pairs; "
+              f"{engine.stats.summary()}", file=sys.stderr)
+
+        # FLOPs of one compiled batch from XLA's own cost analysis
+        # (never fail the record over accounting)
+        flops_per_pair = tfps = mfu = None
+        try:
+            from bench import CHIP_PEAK_BF16_FLOPS, _counted_flops
+
+            (bh, bw), _ = max(engine.registry.hits.items(),
+                              key=lambda kv: kv[1])
+            a = np.zeros((batch_size, bh, bw, 3), np.float32)
+            lower_args = ((variables, a, a) if mesh is None
+                          else (variables, a, a, None, None, None))
+            flops = _counted_flops(step, *lower_args)
+            if flops:
+                flops_per_pair = flops / batch_size
+                tfps = flops_per_pair * (n / dt) / 1e12
+                kind = getattr(jax.devices()[0], "device_kind", "unknown")
+                peak = (CHIP_PEAK_BF16_FLOPS.get(kind)
+                        if jax.devices()[0].platform == "tpu" else None)
+                if peak:
+                    mfu = round(tfps * 1e12 / peak, 4)
+        except Exception as e:
+            print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+
+        reg = engine.registry.stats()
+        return {
+            "batch_size": batch_size,
+            "inflight": args.inflight,
+            "frame_pairs_per_sec": round(n / dt, 3),
+            "latency_p50_ms": round(engine.stats.latency_ms(50), 2),
+            "latency_p99_ms": round(engine.stats.latency_ms(99), 2),
+            "bucket_count": reg["bucket_count"],
+            "compiles": reg["compiles"],
+            "buckets": reg["buckets"],
+            "peak_inflight": engine.stats.peak_inflight,
+            "fetch_blocked_ms": round(engine.stats.fetch_s * 1e3, 2),
+            "pad_frames": engine.stats.pad_frames,
+            "compile_s": round(engine.compile_s, 2),
+            "flops_per_pair": flops_per_pair,
+            "tflops_per_sec": round(tfps, 3) if tfps else None,
+            "mfu": mfu,
+        }
+
+    # baseline: batch 1, or the smallest mesh-divisible batch when
+    # data-parallel (a batch of 1 cannot shard over N chips)
+    base_bs = max(1, args.data_parallel)
+    configs = [run_config(base_bs)]
+    if args.batch > base_bs:
+        configs.append(run_config(args.batch))
+    b1 = configs[0]["frame_pairs_per_sec"]
+    record = {
+        "metric": "serve_frame_pairs_per_sec",
+        "platform": jax.devices()[0].platform,
+        "variant": args.variant + ("-small" if args.small else ""),
+        "iters": args.iters,
+        "sizes": args.sizes,
+        "frames": args.frames,
+        "bucket_multiple": args.bucket_multiple,
+        "configs": configs,
+        # None when only the baseline ran (e.g. --batch <= the
+        # data-parallel baseline) — never a self-ratio of 1.0
+        "speedup_batched_over_b1": (
+            round(configs[-1]["frame_pairs_per_sec"] / b1, 3)
+            if len(configs) > 1 and b1 else None),
+    }
+    assert set(record) == RECORD_KEYS, sorted(set(record) ^ RECORD_KEYS)
+    assert all(set(c) == CONFIG_KEYS for c in configs)
+    print(json.dumps(record), flush=True)
+
+
+def main() -> int:
+    """Parent: spawn the measurement child under the stall watchdog.
+    No jax import on this side — a wedged backend can only hang the
+    child, and the child gets killed."""
+    import signal
+    import threading
+
+    stall_s = float(os.environ.get("SERVE_BENCH_STALL_S", STALL_S))
+    hard_cap_s = float(os.environ.get("SERVE_BENCH_HARD_CAP_S", HARD_CAP_S))
+    env = dict(os.environ, SERVE_BENCH_CHILD="1")
+    child = subprocess.Popen([sys.executable, osp.abspath(__file__)]
+                             + sys.argv[1:], env=env,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+    def _on_term(signum, frame):
+        # the queue's outer `timeout` signals only the parent; forward
+        # the kill so the measurement child is never orphaned holding a
+        # device claim
+        child.terminate()
+        try:
+            child.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            child.kill()
+        sys.exit(128 + signum)
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, _on_term)
+
+    last = [time.monotonic()]
+
+    def pump(src, dst):
+        for line in iter(src.readline, b""):
+            last[0] = time.monotonic()
+            dst.buffer.write(line)
+            dst.flush()
+
+    threads = [
+        threading.Thread(target=pump, args=(child.stdout, sys.stdout),
+                         daemon=True),
+        threading.Thread(target=pump, args=(child.stderr, sys.stderr),
+                         daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    while True:
+        rc = child.poll()
+        if rc is not None:
+            break
+        time.sleep(min(2.0, stall_s / 4))
+        now = time.monotonic()
+        if now - last[0] > stall_s or now - t0 > hard_cap_s:
+            why = (f"silent {now - last[0]:.0f}s (stalled)"
+                   if now - last[0] > stall_s
+                   else f"overran {hard_cap_s:.0f}s")
+            print(f"[serve_bench] child stalled ({why}); killing",
+                  file=sys.stderr)
+            child.terminate()
+            try:
+                child.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()
+            rc = 8
+            break
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    if os.environ.get("SERVE_BENCH_CHILD"):
+        if os.environ.get("SERVE_BENCH_FAKE_HANG"):
+            print("fake child hanging", file=sys.stderr, flush=True)
+            while True:
+                time.sleep(3600)
+        _measure()
+        sys.exit(0)
+    sys.exit(main())
