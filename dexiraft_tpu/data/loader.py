@@ -11,14 +11,26 @@ Replaces torch's DataLoader (core/datasets.py:233-234: bs, shuffle,
   * a thread pool decodes ahead of the training step (the chips, not the
     host, should be the bottleneck). The optional C++ decode path plugs in
     below this layer (dexiraft_tpu.data.native).
+
+Fault tolerance (the resilience layer's data half): a decode failure —
+corrupt PNG, truncated .flo, or a pool worker dying outright — degrades
+throughput, never the run. Failed decodes get bounded retry with
+backoff, then skip-and-count (the batch backfills from its surviving
+samples, mirroring the inference engine's tail-pad); a broken process
+pool is rebuilt in place. PipelineStats carries the counts to the
+logger. Exact resume rides the same counter-based PRNG design:
+``batches(start_epoch=, start_offset=)`` reproduces the stream from any
+(epoch, global-batch offset) position.
 """
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Iterator, Optional
+import time
+from concurrent.futures import BrokenExecutor, ThreadPoolExecutor
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -28,6 +40,42 @@ Batch = Dict[str, np.ndarray]
 def _stack(samples) -> Batch:
     keys = [k for k in samples[0] if k != "extra_info"]
     return {k: np.stack([s[k] for s in samples]) for k in keys}
+
+
+class PipelineStats:
+    """Data-pipeline fault accounting (the loader analog of
+    prefetch.PrefetchStats / profiling.ServeStats): every degradation
+    the pipeline absorbed, countable, so a run that silently skipped
+    half its data cannot masquerade as a healthy one."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.retries = 0          # decode re-submissions (incl. after a
+                                  # pool rebuild)
+        self.skipped_samples = 0  # samples abandoned after the retry
+                                  # budget; their batch slot backfills
+        self.dropped_batches = 0  # batches with NO surviving sample
+        self.worker_restarts = 0  # decode-pool rebuilds (worker death)
+
+    @property
+    def faults(self) -> int:
+        return (self.retries + self.skipped_samples + self.dropped_batches
+                + self.worker_restarts)
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"retries": self.retries,
+                "skipped_samples": self.skipped_samples,
+                "dropped_batches": self.dropped_batches,
+                "worker_restarts": self.worker_restarts}
+
+    def summary(self) -> str:
+        if not self.faults:
+            return "no pipeline faults"
+        return (f"{self.retries} decode retries, {self.skipped_samples} "
+                f"samples skipped, {self.dropped_batches} batches dropped, "
+                f"{self.worker_restarts} worker-pool restarts")
 
 
 # --- process-worker plumbing -------------------------------------------------
@@ -48,6 +96,134 @@ def _process_worker_init(dataset, seed: int) -> None:
 def _process_decode(epoch: int, index: int) -> Batch:
     rng = np.random.default_rng((_WORKER_STATE["seed"], epoch, index))
     return _WORKER_STATE["dataset"].sample(int(index), rng)
+
+
+class _FeederError:
+    """Queue marker carrying a fatal feeder-thread exception to the
+    consumer side of the batch stream."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _FailedFuture:
+    """Future-shaped carrier for a submit()-time error, so the consumer's
+    one result-with-retry path handles enqueue failures too."""
+
+    def __init__(self, exc: BaseException):
+        self._exc = exc
+
+    def result(self):
+        raise self._exc
+
+
+class _PoolManager:
+    """Owns the decode pool and rebuilds it when workers die.
+
+    A ProcessPoolExecutor whose worker exits (OOM-kill, segfault,
+    injected os._exit) becomes permanently broken: every pending and
+    future submission raises BrokenProcessPool. Both the feeder thread
+    (submitting ahead) and the consumer (resolving results) can observe
+    the break, so rebuild() is generation-guarded behind a lock — the
+    first observer rebuilds, later observers of the same broken
+    generation just pick up the fresh pool.
+    """
+
+    # consecutive rebuilds WITHOUT a single successful decode in between
+    # before giving up: a pool whose workers die at startup (bad spawn
+    # entrypoint, broken install) would otherwise rebuild forever while
+    # the consumer waits on batches that can never arrive
+    MAX_CONSECUTIVE_REBUILDS = 8
+
+    def __init__(self, loader: "Loader"):
+        self.loader = loader
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._rebuilds_since_success = 0
+        self._closed = False
+        self._pool = self._build()
+
+    def note_success(self) -> None:
+        self._rebuilds_since_success = 0
+
+    def _build(self):
+        ld = self.loader
+        if ld.worker_mode == "process":
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            return ProcessPoolExecutor(
+                max_workers=ld.num_workers,
+                mp_context=mp.get_context(ld.mp_start_method),
+                initializer=_process_worker_init,
+                initargs=(ld.dataset, ld.seed))
+        return ThreadPoolExecutor(max_workers=ld.num_workers)
+
+    def _submit_raw(self, pool, epoch: int, index: int):
+        if self.loader.worker_mode == "process":
+            return pool.submit(_process_decode, epoch, int(index))
+        return pool.submit(self.loader._decode, epoch, int(index))
+
+    def rebuild(self, seen_generation: int) -> None:
+        """Replace the pool unless another thread already did."""
+        with self._lock:
+            if self._closed:
+                # shutdown() raced the feeder's last submissions: the
+                # "broken" pool is the one we closed on purpose — do
+                # not resurrect a pool nobody will shut down, and do
+                # not count a phantom worker restart
+                return
+            if seen_generation != self._generation:
+                return
+            self._rebuilds_since_success += 1
+            if self._rebuilds_since_success > self.MAX_CONSECUTIVE_REBUILDS:
+                raise RuntimeError(
+                    f"decode pool produced no result across "
+                    f"{self._rebuilds_since_success - 1} consecutive "
+                    f"rebuilds — the workers are dying at startup "
+                    f"(worker_mode={self.loader.worker_mode!r}, "
+                    f"mp_start_method={self.loader.mp_start_method!r}); "
+                    f"this is not a recoverable data fault")
+            old = self._pool
+            self._pool = self._build()
+            self._generation += 1
+            self.loader.stats.worker_restarts += 1
+        print(f"[loader] decode pool broken; rebuilt "
+              f"({self.loader.stats.worker_restarts} restart(s) so far)",
+              flush=True)
+        try:
+            old.shutdown(wait=False, cancel_futures=True)
+        except Exception:
+            pass
+
+    def submit(self, epoch: int, index: int):
+        """Submit a decode; the returned future is tagged with the pool
+        generation that produced it, so a consumer observing its failure
+        rebuilds THAT generation (idempotent under races)."""
+        with self._lock:
+            pool, generation = self._pool, self._generation
+        try:
+            fut = self._submit_raw(pool, epoch, index)
+        except (BrokenExecutor, RuntimeError):
+            # RuntimeError covers "cannot schedule new futures after
+            # shutdown" races during a concurrent rebuild
+            self.rebuild(generation)
+            with self._lock:
+                pool, generation = self._pool, self._generation
+            try:
+                fut = self._submit_raw(pool, epoch, index)
+            except Exception as e2:
+                fut = _FailedFuture(e2)
+        except Exception as e:
+            fut = _FailedFuture(e)
+        fut.pool_generation = generation
+        return fut
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            pool = self._pool
+        pool.shutdown(wait=False, cancel_futures=True)
 
 
 class Loader:
@@ -71,6 +247,8 @@ class Loader:
         process_count: int = 1,
         worker_mode: str = "thread",
         mp_start_method: str = "fork",
+        max_retries: int = 3,
+        retry_backoff_s: float = 0.05,
     ):
         if batch_size % process_count:
             raise ValueError(
@@ -94,6 +272,23 @@ class Loader:
         # the default fork start method, or pass mp_start_method="spawn".
         self.worker_mode = worker_mode
         self.mp_start_method = mp_start_method
+        # decode-fault budget: a sample gets max_retries re-submissions
+        # (exponential backoff from retry_backoff_s) before it is
+        # skipped and its batch slot backfilled
+        self.max_retries = max(0, max_retries)
+        self.retry_backoff_s = retry_backoff_s
+        self.stats = PipelineStats()
+        # (epoch, offset) of each YIELDED batch, in yield order — the
+        # trainer pops one entry per batch it consumes, so its stream
+        # position stays exact even when a batch with no surviving
+        # samples is dropped without a yield (the position of a dropped
+        # batch never enters the queue); alignment survives any
+        # prefetch depth because both sides are strictly FIFO. maxlen
+        # bounds the memory of consumers that never pop (benches,
+        # plain `for b in loader:` users) — a popping consumer can lag
+        # at most its prefetch depth, far under the bound
+        self.positions: "collections.deque[Tuple[int, int]]" = (
+            collections.deque(maxlen=64))
 
     def __len__(self) -> int:
         n = len(self.dataset) // self.global_batch
@@ -111,23 +306,54 @@ class Loader:
         rng = np.random.default_rng((self.seed, epoch, index))
         return self.dataset.sample(int(index), rng)
 
-    def batches(self, start_epoch: int = 0) -> Iterator[Batch]:
-        """Endless batch stream; this host's slice of each global batch."""
-        if self.worker_mode == "process":
-            import multiprocessing as mp
-            from concurrent.futures import ProcessPoolExecutor
+    def _resolve(self, pools: _PoolManager, epoch: int, index: int, fut):
+        """One sample's result, with bounded retry: pool breakage
+        rebuilds + resubmits, decode errors resubmit with backoff, and
+        a sample still failing after the budget is skipped (None)."""
+        attempt = 0
+        while True:
+            try:
+                sample = fut.result()
+                pools.note_success()
+                return sample
+            except BrokenExecutor:
+                # the pool died under this future; rebuild the future's
+                # OWN generation (idempotent under races: a concurrent
+                # observer of the same break rebuilds once) and charge
+                # one attempt — a sample that deterministically kills
+                # its worker must exhaust the budget, not rebuild pools
+                # forever
+                pools.rebuild(getattr(fut, "pool_generation", 0))
+            except Exception:
+                pass  # plain decode failure; retry below
+            attempt += 1
+            if attempt > self.max_retries:
+                self.stats.skipped_samples += 1
+                print(f"[loader] sample (epoch {epoch}, index {index}) "
+                      f"failed {attempt} attempt(s); skipping it "
+                      f"({self.stats.skipped_samples} skipped so far)",
+                      flush=True)
+                return None
+            self.stats.retries += 1
+            time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+            fut = pools.submit(epoch, index)
 
-            pool = ProcessPoolExecutor(
-                max_workers=self.num_workers,
-                mp_context=mp.get_context(self.mp_start_method),
-                initializer=_process_worker_init,
-                initargs=(self.dataset, self.seed))
-            submit = lambda epoch, i: pool.submit(_process_decode, epoch, i)  # noqa: E731
-        else:
-            pool = ThreadPoolExecutor(max_workers=self.num_workers)
-            submit = lambda epoch, i: pool.submit(self._decode, epoch, i)  # noqa: E731
+    def batches(self, start_epoch: int = 0,
+                start_offset: int = 0) -> Iterator[Batch]:
+        """Endless batch stream; this host's slice of each global batch.
+
+        start_epoch/start_offset position the stream at global batch
+        `start_offset` of `start_epoch` — with the counter-based PRNG
+        streams this reproduces the EXACT sample sequence an
+        interrupted run would have consumed next (resilience.stream).
+        """
+        if len(self) > 0:  # normalize an offset past the epoch end
+            start_epoch += start_offset // len(self)
+            start_offset %= len(self)
+        pools = _PoolManager(self)
         out: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
+        self.positions.clear()  # one live stream per Loader
 
         # a trailing partial global batch cannot be split evenly across
         # hosts — some would yield one more batch than others and the
@@ -137,35 +363,79 @@ class Loader:
 
         def submit_loop():
             epoch = start_epoch
-            while not stop.is_set():
-                order = self._epoch_order(epoch)
-                usable = (len(order) // self.global_batch * self.global_batch
-                          if drop_last else len(order))
-                for b0 in range(0, usable, self.global_batch):
-                    lo = b0 + self.process_index * self.local_batch
-                    ids = order[lo:lo + self.local_batch]
-                    if len(ids) == 0:
-                        continue
-                    futs = [submit(epoch, i) for i in ids]
-                    while not stop.is_set():  # never park forever on put
-                        try:
-                            out.put(futs, timeout=0.1)
-                            break
-                        except queue.Full:
+            skip = start_offset * self.global_batch
+            try:
+                while not stop.is_set():
+                    order = self._epoch_order(epoch)
+                    usable = (len(order) // self.global_batch
+                              * self.global_batch
+                              if drop_last else len(order))
+                    for b0 in range(skip, usable, self.global_batch):
+                        lo = b0 + self.process_index * self.local_batch
+                        ids = order[lo:lo + self.local_batch]
+                        if len(ids) == 0:
                             continue
-                    if stop.is_set():
+                        # tagged with the batch's (epoch, offset) so the
+                        # consumer can publish the exact position of
+                        # every yielded batch (dropped ones never are)
+                        work = (epoch, b0 // self.global_batch,
+                                [(int(i), pools.submit(epoch, i))
+                                 for i in ids])
+                        while not stop.is_set():  # never park forever on put
+                            try:
+                                out.put(work, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                        if stop.is_set():
+                            return
+                    epoch += 1
+                    skip = 0
+            except BaseException as e:
+                # a fatal feeder error (e.g. the pool-rebuild bound) must
+                # surface in the CONSUMER, not die with this thread while
+                # the trainer blocks on a batch that will never come
+                while not stop.is_set():
+                    try:
+                        out.put(_FeederError(e), timeout=0.1)
                         return
-                epoch += 1
+                    except queue.Full:
+                        continue
 
         feeder = threading.Thread(target=submit_loop, daemon=True)
         feeder.start()
         try:
             while True:
-                futs = out.get()
-                yield _stack([f.result() for f in futs])
+                work = out.get()
+                if isinstance(work, _FeederError):
+                    raise work.exc
+                epoch_b, offset_b, pairs = work
+                samples = [self._resolve(pools, epoch_b, i, f)
+                           for i, f in pairs]
+                good = [s for s in samples if s is not None]
+                if not good:
+                    # nothing in this batch survived; drop it rather
+                    # than fabricate data (single-host only: a
+                    # multi-host run would need a collective agreement
+                    # to drop, see docs/resilience.md). No position is
+                    # published: the trainer never consumed this offset,
+                    # so resume will revisit (and re-drop) it
+                    self.stats.dropped_batches += 1
+                    print(f"[loader] batch with no surviving samples "
+                          f"dropped ({self.stats.dropped_batches} so far)",
+                          flush=True)
+                    continue
+                n_good = len(good)
+                while len(good) < len(pairs):
+                    # backfill skipped slots by replicating survivors —
+                    # batch shape stays stable (one compiled step), and
+                    # a duplicated good sample beats a crashed run
+                    good.append(good[len(good) % n_good])
+                self.positions.append((epoch_b, offset_b))
+                yield _stack(good)
         finally:
             stop.set()
-            pool.shutdown(wait=False, cancel_futures=True)
+            pools.shutdown()
 
     def __iter__(self) -> Iterator[Batch]:
         return self.batches()

@@ -50,12 +50,15 @@ class PrefetchStats:
     def stall_per_batch_s(self) -> float:
         return self.stall_s / self.batches if self.batches else 0.0
 
-    def summary(self) -> str:
-        return (f"{self.batches} batches, prefetch stall "
+    def summary(self, pipeline_stats=None) -> str:
+        base = (f"{self.batches} batches, prefetch stall "
                 f"{self.stall_s * 1e3:.1f} ms total "
                 f"({self.stall_per_batch_s * 1e3:.3f} ms/batch, "
                 f"{self.stalls} stalled yields; warm fill "
                 f"{self.warm_fill_s * 1e3:.1f} ms)")
+        if pipeline_stats is not None and pipeline_stats.faults:
+            base += f"; {pipeline_stats.summary()}"
+        return base
 
 
 class DevicePrefetcher:
@@ -73,6 +76,7 @@ class DevicePrefetcher:
         put: Optional[Callable[[Any], Any]] = None,
         *,
         depth: int = 2,
+        pipeline_stats=None,
     ):
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
@@ -83,6 +87,15 @@ class DevicePrefetcher:
         self.put = put
         self.depth = depth
         self.stats = PrefetchStats()
+        # fault counters of the HOST pipeline feeding this prefetcher (a
+        # Loader's PipelineStats) — passed explicitly when the iterable
+        # is a bare generator (loader.batches(...)), else picked off a
+        # Loader's .stats; surfaced by summary() so the end-of-run
+        # prefetch line also reports pipeline degradation
+        if pipeline_stats is None:
+            pipeline_stats = getattr(iterable, "stats", None)
+        self.pipeline_stats = (pipeline_stats
+                               if hasattr(pipeline_stats, "faults") else None)
         self._it = iter(iterable)
         self._buf: "collections.deque" = collections.deque()
         self._warm = False
@@ -132,6 +145,10 @@ class DevicePrefetcher:
         self.stats.batches += 1
         return self._buf.popleft()
 
+    def summary(self) -> str:
+        """One line: prefetch stall accounting + any pipeline faults."""
+        return self.stats.summary(self.pipeline_stats)
+
     def close(self) -> None:
         """Close the underlying host iterator (e.g. a Loader generator,
         whose feeder thread and worker pool stop on close) and drop the
@@ -148,9 +165,11 @@ def prefetch_to_device(
     mesh=None,
     *,
     depth: int = 2,
+    pipeline_stats=None,
 ) -> DevicePrefetcher:
     """Convenience wrapper: prefetch with the train step's input layout
     for `mesh` (parallel.mesh.batch_putter; plain device_put when None)."""
     from dexiraft_tpu.parallel.mesh import batch_putter
 
-    return DevicePrefetcher(iterable, batch_putter(mesh), depth=depth)
+    return DevicePrefetcher(iterable, batch_putter(mesh), depth=depth,
+                            pipeline_stats=pipeline_stats)

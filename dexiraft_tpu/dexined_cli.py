@@ -210,16 +210,15 @@ def train(args) -> None:
         # batch's own update poisoning the state the save would persist
         if not args.no_guard and guard.poisoned(float(loss),
                                                 bool(state_ok)):
-            guard.consume_rollback(float(loss), bool(state_ok),
-                                   f"epoch {epoch}", last_saved)
+            rollback_msg = guard.consume_rollback(
+                float(loss), bool(state_ok), f"epoch {epoch}", last_saved,
+                ckpt_dir=args.checkpoint)
             prev = ckpt_io.restore_checkpoint(args.checkpoint, state,
                                               step=last_saved)
             params, batch_stats, opt_state = (
                 prev.params, prev.batch_stats, prev.opt_state)
             print(f"[guard] poisoned epoch {epoch} (loss {float(loss):.4g}, "
-                  f"state_finite={bool(state_ok)}); restored "
-                  f"step {last_saved} "
-                  f"(rollback {guard.rollbacks}/{args.max_rollbacks})")
+                  f"state_finite={bool(state_ok)}); {rollback_msg}")
             continue
         ckpt_io.save_checkpoint(args.checkpoint, state)
         last_saved = int(state.step)

@@ -74,6 +74,13 @@ def load_variables(args):
     from dexiraft_tpu.train import checkpoint as ckpt
     from dexiraft_tpu.train.state import create_state
 
+    # a missing/empty --model dir is an operator typo, not a program
+    # bug: fail as ONE actionable line (path + nearest candidate dirs)
+    # instead of the orbax traceback it used to produce
+    try:
+        ckpt.require_checkpoints(args.model)
+    except FileNotFoundError as e:
+        raise SystemExit(f"eval: {e}")
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
                                  corr_impl=args.corr_impl,

@@ -1,0 +1,55 @@
+"""Resilience layer: the runtime survives what the reference died from.
+
+The reference's documented failure mode was SILENT (SURVEY.md §5): a
+diverged run kept logging, and outages killed runs that were restarted
+by hand with a cold optimizer. PR 1-3 fixed divergence (guard +
+rollback + full-state checkpoints); this package closes the remaining
+ways a multi-day run dies or silently degrades:
+
+  * preemption.PreemptionHandler — SIGTERM/SIGINT become ONE graceful
+    emergency checkpoint instead of losing up to val_freq steps.
+  * stream.StreamPosition — the data-stream position (epoch, batch
+    offset) is checkpointed alongside the train state, so --resume
+    continues the EXACT sample sequence instead of replaying epoch 0.
+  * verify.restore_verified — restore-time integrity check (tree
+    structure + leaf shapes + finiteness sample) with fallback to the
+    previous step: a truncated or poisoned checkpoint degrades to an
+    older one with a clear message, never a crash or silent garbage.
+  * retention.RetentionPolicy — --keep N / --keep_best GC so
+    checkpoints stop accumulating unboundedly.
+  * chaos — fault-injection harness (corrupt samples, worker death,
+    SIGTERM mid-step, truncated checkpoints) that the tests and
+    scripts/chaos_smoke.py use to prove every recovery path recovers.
+
+The data-pipeline half (bounded retry-with-backoff, skip-and-count,
+decode-pool rebuild) lives in data.loader — PipelineStats is re-exported
+here for the one-stop import.
+"""
+
+from dexiraft_tpu.data.loader import PipelineStats
+from dexiraft_tpu.resilience.preemption import PreemptionHandler
+from dexiraft_tpu.resilience.retention import RetentionPolicy
+from dexiraft_tpu.resilience.stream import (
+    StreamPosition,
+    delete_position,
+    load_position,
+    save_position,
+)
+from dexiraft_tpu.resilience.verify import (
+    CheckpointIntegrityError,
+    restore_verified,
+    verify_state,
+)
+
+__all__ = [
+    "CheckpointIntegrityError",
+    "PipelineStats",
+    "PreemptionHandler",
+    "RetentionPolicy",
+    "StreamPosition",
+    "delete_position",
+    "load_position",
+    "restore_verified",
+    "save_position",
+    "verify_state",
+]

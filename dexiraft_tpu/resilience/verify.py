@@ -1,0 +1,131 @@
+"""Restore-time checkpoint integrity: verify, and fall back, loudly.
+
+A checkpoint can be bad in two distinct ways and the stock restore path
+handled neither: a TRUNCATED/corrupt step (preemption mid-write, disk
+trouble) crashes deep inside orbax, and a step that restores cleanly but
+holds non-finite leaves (saved by a guard-less run, or poisoned storage)
+loads silently and wastes a relaunch before the divergence guard fires.
+
+``restore_verified`` walks the saved steps newest-first: each candidate
+must (a) restore at all, (b) match the template's tree structure and
+leaf shapes/dtypes — orbax's StandardRestore enforces most of this, the
+explicit check catches drift in what it tolerates — and (c) pass a
+finiteness sample over the float leaves. The first step that passes
+wins; everything skipped is reported in one line each, so "resumed from
+step 40000 because 45000 was truncated" is visible in the log instead of
+being silently wrong.
+
+Once a good step restores, the skipped bad steps are DELETED (dir and
+sidecar): orbax's CheckpointManager.save() to an existing step dir is a
+silent no-op, so a damaged step left in place would swallow the very
+re-save that retraining toward that step number performs — the run
+would "finish" with its newest checkpoint still the truncated one.
+When every candidate fails, nothing is deleted (forensics beat tidiness
+on a total loss) and CheckpointIntegrityError carries the skip list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from dexiraft_tpu.resilience.stream import delete_position
+from dexiraft_tpu.train import checkpoint as ckpt
+from dexiraft_tpu.train.state import TrainState
+
+# leaves sampled for the finiteness check: every Nth float leaf plus the
+# largest one (the big encoder kernels are where storage corruption is
+# most likely to land by mass)
+_SAMPLE_EVERY = 7
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """No saved step under the directory passed verification."""
+
+
+def verify_state(state, template, sample_every: int = _SAMPLE_EVERY) -> None:
+    """Raise CheckpointIntegrityError unless `state` matches `template`'s
+    tree structure and leaf shapes and passes a finiteness sample."""
+    got = jax.tree_util.tree_structure(state)
+    want = jax.tree_util.tree_structure(template)
+    if got != want:
+        raise CheckpointIntegrityError(
+            f"tree structure mismatch: restored {got} != expected {want}")
+
+    flat_got = jax.tree_util.tree_flatten_with_path(state)[0]
+    flat_want = jax.tree_util.tree_flatten_with_path(template)[0]
+    for (kp, leaf), (_, ref) in zip(flat_got, flat_want):
+        if tuple(np.shape(leaf)) != tuple(np.shape(ref)):
+            raise CheckpointIntegrityError(
+                f"leaf {jax.tree_util.keystr(kp)}: shape "
+                f"{tuple(np.shape(leaf))} != expected {tuple(np.shape(ref))}")
+
+    # .dtype/.size are attributes on numpy and jax arrays alike — never
+    # np.asarray() here: that would copy the WHOLE model device->host
+    # just to pick the sample (asarray is reserved for sampled leaves)
+    floats = [(kp, leaf) for kp, leaf in flat_got
+              if np.issubdtype(getattr(leaf, "dtype", np.dtype(object)),
+                               np.floating)]
+    sample = floats[::max(1, sample_every)]
+    if floats:
+        largest = max(floats, key=lambda e: e[1].size)
+        if all(largest[0] != kp for kp, _ in sample):
+            sample.append(largest)
+    for kp, leaf in sample:
+        # |x|.sum() is finite iff every element is (inf and nan both
+        # survive the reduction) — one scalar readback per sampled leaf
+        if not np.isfinite(np.abs(np.asarray(leaf)).sum()):
+            raise CheckpointIntegrityError(
+                f"leaf {jax.tree_util.keystr(kp)} contains non-finite "
+                f"values")
+
+
+def restore_verified(
+    directory: str,
+    template: TrainState,
+    step: Optional[int] = None,
+    verbose: bool = True,
+) -> Tuple[TrainState, int]:
+    """Restore the newest step (<= `step` if given) that passes
+    verification, falling back step by step. Returns (state, step).
+
+    Raises CheckpointIntegrityError when every candidate fails —
+    crashing with the full skip list beats silently training from a
+    fresh init under a name that has checkpoints.
+    """
+    steps = sorted(ckpt.all_steps(directory), reverse=True)
+    if step is not None:
+        steps = [s for s in steps if s <= step]
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+
+    skipped = []
+    for s in steps:
+        try:
+            state = ckpt.restore_checkpoint(directory, template, step=s)
+            verify_state(state, template)
+        except Exception as e:  # orbax raises many types on corrupt input
+            skipped.append((s, e))
+            if verbose:
+                print(f"[resilience] checkpoint {directory} step {s} failed "
+                      f"verification ({type(e).__name__}: {e}); trying the "
+                      f"previous step", flush=True)
+            continue
+        for bad, _ in skipped:
+            # remove what failed verification: orbax silently no-ops a
+            # save() onto an existing step dir, so a damaged step left
+            # behind would eat the re-save when training reaches this
+            # step number again (see module docstring)
+            ckpt.delete_step(directory, bad)
+            delete_position(directory, bad)
+        if skipped and verbose:
+            print(f"[resilience] restored step {s} after skipping "
+                  f"{len(skipped)} bad step(s) (now deleted): "
+                  f"{[b for b, _ in skipped]}", flush=True)
+        return state, s
+    raise CheckpointIntegrityError(
+        f"no restorable checkpoint under {directory}: all of "
+        f"{[b for b, _ in skipped]} failed verification "
+        f"(last error: {skipped[-1][1]})")

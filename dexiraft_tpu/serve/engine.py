@@ -126,6 +126,55 @@ class InferenceEngine:
         self.compile_s = 0.0  # time inside first-dispatch eval_fn calls
         self._inflight: "collections.deque[_Ticket]" = collections.deque()
 
+    # ---- input validation ----------------------------------------------
+
+    def _validate_item(self, index: int, item: Dict[str, Any]) -> None:
+        """Reject malformed frames at the door with a clear ValueError.
+
+        Without this, a wrong rank/dtype/channel count fails deep inside
+        the jitted bucket step (a shape mismatch against a compiled
+        executable, or a tracer-time TypeError) where the message names
+        engine internals rather than the offending input.
+
+        The normalized arrays are written back into `item`: validating
+        an np.asarray VIEW while the engine later indexes the raw value
+        would let an array-like (a nested list) pass the checks and
+        still crash on `.shape` — the exact opacity this guard removes.
+        """
+        shapes = {}
+        for key in ("image1", "image2"):
+            im = item.get(key)
+            if im is None:
+                raise ValueError(f"item {index}: missing {key!r}")
+            im = item[key] = np.asarray(im)
+            if im.ndim != 3:
+                raise ValueError(
+                    f"item {index}: {key!r} must be rank-3 (H, W, C), got "
+                    f"shape {im.shape}")
+            if im.shape[-1] != 3:
+                raise ValueError(
+                    f"item {index}: {key!r} must have 3 channels (RGB HWC), "
+                    f"got {im.shape[-1]} (shape {im.shape})")
+            if not (np.issubdtype(im.dtype, np.floating)
+                    or np.issubdtype(im.dtype, np.integer)):
+                raise ValueError(
+                    f"item {index}: {key!r} dtype must be a real numeric "
+                    f"type castable to float32, got {im.dtype}")
+            shapes[key] = im.shape
+        if shapes["image1"] != shapes["image2"]:
+            raise ValueError(
+                f"item {index}: image1 {shapes['image1']} and image2 "
+                f"{shapes['image2']} must agree (one flow field per pair)")
+        fi = item.get("flow_init")
+        if fi is not None:
+            fi = item["flow_init"] = np.asarray(fi)
+            # spatial dims are bucket-relative (the carry stays at the
+            # PADDED 1/8 resolution), so only rank/channels are checkable
+            if fi.ndim != 3 or fi.shape[-1] != 2:
+                raise ValueError(
+                    f"item {index}: flow_init must be rank-3 (H/{self.config.stride}, "
+                    f"W/{self.config.stride}, 2), got shape {fi.shape}")
+
     # ---- dispatch side -------------------------------------------------
 
     def _dispatch(self, bucket: Tuple[int, int],
@@ -215,6 +264,7 @@ class InferenceEngine:
         cfg = self.config
         pending: Dict[Tuple[int, int], List[Tuple[int, Dict[str, Any]]]] = {}
         for index, item in enumerate(items):
+            self._validate_item(index, item)
             h, w = item["image1"].shape[-3], item["image1"].shape[-2]
             bucket = self.registry.bucket_for(h, w)
             pending.setdefault(bucket, []).append((index, item))
@@ -242,6 +292,8 @@ class InferenceEngine:
             raise ValueError(f"{len(items)} items > batch_size "
                              f"{self.config.batch_size}")
         mode = mode or self.config.mode
+        for index, item in enumerate(items):
+            self._validate_item(index, item)
         buckets = {self.registry.bucket_for(
             it["image1"].shape[-3], it["image1"].shape[-2]) for it in items}
         if len(buckets) > 1:

@@ -71,6 +71,63 @@ def latest_step(directory: str) -> Optional[int]:
     return _manager(directory).latest_step()
 
 
+def all_steps(directory: str) -> "list[int]":
+    """Ascending list of saved steps."""
+    return sorted(int(s) for s in _manager(directory).all_steps())
+
+
+def delete_step(directory: str, step: int) -> None:
+    """Remove one saved step (retention GC). Falls back to an rmtree of
+    the step dir when the manager refuses (e.g. a half-written step the
+    manager no longer tracks)."""
+    mgr = _manager(directory, refresh=False)
+    try:
+        mgr.delete(int(step))
+    except Exception:
+        import shutil
+
+        shutil.rmtree(os.path.join(directory, str(int(step))),
+                      ignore_errors=True)
+        if hasattr(mgr, "reload"):
+            mgr.reload()
+
+
+def _fs_steps(directory: str) -> "list[int]":
+    """Step dirs found by a plain filesystem walk — no CheckpointManager,
+    so probing a path NEVER creates it (the cached managers are built
+    with create=True, which would turn every probe into a mkdir)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(int(n) for n in names
+                  if n.isdigit() and os.path.isdir(os.path.join(directory, n)))
+
+
+def require_checkpoints(directory: str) -> None:
+    """One-line actionable error for a missing or empty checkpoint dir.
+
+    The orbax path for this failure is a multi-screen traceback ending in
+    an internal FileNotFoundError; here the operator gets the offending
+    path plus the nearest sibling dirs that DO hold checkpoints (the
+    usual failure is a typo'd or stale experiment name).
+    """
+    if _fs_steps(directory):
+        return
+    parent = os.path.dirname(os.path.abspath(directory)) or "."
+    try:
+        siblings = sorted(n for n in os.listdir(parent)
+                          if _fs_steps(os.path.join(parent, n)))
+    except OSError:
+        siblings = []
+    detail = ("directory does not exist"
+              if not os.path.isdir(directory) else "no saved steps in it")
+    hint = (f"; checkpoint dirs under {parent!r}: {', '.join(siblings[:8])}"
+            if siblings else f"; no checkpoint dirs under {parent!r} either")
+    raise FileNotFoundError(
+        f"no checkpoints under {directory!r} ({detail}){hint}")
+
+
 def restore_checkpoint(
     directory: str, template: TrainState, step: Optional[int] = None
 ) -> TrainState:

@@ -36,14 +36,27 @@ class DivergenceGuard:
                 or not state_ok)
 
     def consume_rollback(self, loss_v: float, state_ok: bool,
-                         where: str, last_saved) -> None:
-        """Spend one rollback or raise if unrecoverable."""
+                         where: str, last_saved,
+                         ckpt_dir: "str | None" = None) -> str:
+        """Spend one rollback or raise if unrecoverable.
+
+        Returns (and, on abort, embeds in the RuntimeError) a message
+        naming the checkpoint dir and restore step, so the operator can
+        inspect the rolled-back state — `eval --model <dir>` it, diff
+        its metrics — without reading the trainer's source to learn
+        where the state went.
+        """
+        target = (f"step {last_saved}" if ckpt_dir is None
+                  else f"{ckpt_dir} step {last_saved}")
         if last_saved is None or self.rollbacks >= self.max_rollbacks:
             raise RuntimeError(
                 f"training diverged (loss {loss_v:.4g}, "
                 f"state_finite={state_ok}) at {where}"
                 + (" before this run saved any checkpoint"
                    if last_saved is None else
-                   f" after {self.rollbacks} rollbacks")
+                   f" after {self.rollbacks} rollbacks; last good "
+                   f"checkpoint: {target}")
                 + "; lower the lr or inspect the data")
         self.rollbacks += 1
+        return (f"restoring {target} "
+                f"(rollback {self.rollbacks}/{self.max_rollbacks})")

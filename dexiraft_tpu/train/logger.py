@@ -22,10 +22,16 @@ class Logger:
         log_dir: Optional[str] = None,
         tensorboard: bool = True,
         model_iters: int = 12,
+        pipeline_stats=None,
     ):
         self.sum_freq = sum_freq
         self.log_dir = log_dir
         self.model_iters = model_iters
+        # data-pipeline fault counters (data.loader.PipelineStats): read
+        # at every emit so skip/restart counts are visible IN the run's
+        # log stream, not only in a post-mortem — the silent-degradation
+        # analog of the divergence guard's loud rollback
+        self.pipeline_stats = pipeline_stats
         self.total_steps = 0
         self.running: Dict[str, float] = {}
         self._tb = None
@@ -65,10 +71,22 @@ class Logger:
         means["steps/sec"] = steps_per_sec
         means["iters/sec"] = steps_per_sec * self.model_iters
 
+        pipeline = ""
+        ps = self.pipeline_stats
+        if ps is not None and ps.faults:
+            # cumulative counts (not per-window deltas): an operator
+            # grepping any single line sees the run's full damage
+            pipeline = (f"  [pipeline: {ps.skipped_samples} skipped, "
+                        f"{ps.retries} retries, {ps.dropped_batches} "
+                        f"batches dropped, {ps.worker_restarts} "
+                        f"worker restarts]")
+            for k, v in ps.as_dict().items():
+                means[f"pipeline/{k}"] = v
+
         lr = means.get("lr", 0.0)
         keys = [k for k in ("epe", "1px", "3px", "5px", "loss") if k in means]
         body = ", ".join(f"{means[k]:10.4f}" for k in keys)
-        print(f"[{self.total_steps:6d}, {lr:10.7f}] {body}  ({steps_per_sec:.2f} steps/s)")
+        print(f"[{self.total_steps:6d}, {lr:10.7f}] {body}  ({steps_per_sec:.2f} steps/s){pipeline}")
 
         self._write(means, self.total_steps)
         self.running = {}

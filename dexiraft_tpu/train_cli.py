@@ -141,6 +141,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_rollbacks", type=int, default=3,
                    help="abort after this many rollbacks (persistent "
                         "divergence needs a human: lower the lr)")
+    # fault-tolerant runtime (docs/resilience.md): preemption becomes one
+    # guard-checked emergency save; --resume continues the EXACT sample
+    # sequence via the stream-position sidecar saved with every
+    # checkpoint; restores verify integrity and fall back a step instead
+    # of crashing on (or silently loading) a truncated checkpoint
+    p.add_argument("--keep", type=int, default=0,
+                   help="retention: keep only the newest N checkpoints "
+                        "(0 = keep all); the current rollback target is "
+                        "never deleted")
+    p.add_argument("--keep_best", action="store_true",
+                   help="retention also keeps the checkpoint with the "
+                        "best validation EPE even once it ages out of "
+                        "the --keep window")
+    p.add_argument("--on_preempt", choices=["save", "abort"],
+                   default="save",
+                   help="SIGTERM/SIGINT response: 'save' finishes the "
+                        "current step and writes one emergency "
+                        "checkpoint + data-stream position (a second "
+                        "signal aborts immediately); 'abort' stops "
+                        "without saving (the reference behavior)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="fault injection for tests/scripts/chaos_smoke "
+                        "(resilience.chaos.parse_spec), e.g. "
+                        "'sigterm@30': real SIGTERM after step 30")
     return p
 
 
@@ -218,6 +242,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     from dexiraft_tpu.data.loader import Loader
     from dexiraft_tpu.data.prefetch import prefetch_to_device
     from dexiraft_tpu.parallel.mesh import make_mesh
+    from dexiraft_tpu.resilience import (
+        PreemptionHandler,
+        RetentionPolicy,
+        StreamPosition,
+        load_position,
+        restore_verified,
+        save_position,
+    )
     from dexiraft_tpu.train import checkpoint as ckpt
     from dexiraft_tpu.train.logger import Logger
     from dexiraft_tpu.train.state import create_state, param_count
@@ -249,11 +281,22 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     # rollback target. A stale dir from a previous experiment must never
     # be spliced into a fresh run by the guard.
     last_saved = None
+    # position of the NEXT global batch to consume (resilience.stream):
+    # checkpointed as a sidecar with every save, so --resume continues
+    # the exact sample sequence instead of replaying from epoch 0
+    stream_pos = StreamPosition()
     if args.resume and ckpt.latest_step(ckpt_dir) is not None:
-        state = ckpt.restore_checkpoint(ckpt_dir, state)
-        last_saved = ckpt.latest_step(ckpt_dir)
-        print(f"Resumed full state at step {int(state.step)}")
+        # verified restore: a truncated/poisoned newest step falls back
+        # to the previous one with a message instead of crashing here
+        state, last_saved = restore_verified(ckpt_dir, state)
+        pos = load_position(ckpt_dir, last_saved, seed=tc.seed)
+        if pos is not None:
+            stream_pos = pos
+        print(f"Resumed full state at step {int(state.step)} "
+              f"(data stream: epoch {stream_pos.epoch}, "
+              f"batch {stream_pos.offset})")
     elif args.restore_ckpt:
+        ckpt.require_checkpoints(args.restore_ckpt)
         prev = ckpt.restore_checkpoint(args.restore_ckpt, state)
         merged, skipped = ckpt.restore_params_into(state.params, prev.params,
                                                    verbose=True)
@@ -268,10 +311,11 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         dataset, tc.batch_size, seed=tc.seed, num_workers=args.num_workers,
         worker_mode=args.worker_mode, mp_start_method="spawn",
         process_index=jax.process_index(), process_count=jax.process_count())
+    batches_per_epoch = max(len(loader), 1)
 
     step_fn = make_train_step(cfg, tc, mesh=mesh)
     logger = Logger(tc.sum_freq, log_dir=osp.join(args.log_dir, tc.name),
-                    model_iters=tc.iters)
+                    model_iters=tc.iters, pipeline_stats=loader.stats)
     validate = _make_validators(cfg, tc.validation,
                                 lambda: state.variables)
 
@@ -283,13 +327,42 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
 
     total_steps = int(state.step)
     guard = DivergenceGuard(args.guard_threshold, args.max_rollbacks)
+    # bound to ckpt_dir: --keep_best scores persist in
+    # <ckpt_dir>/retention.json, so a preempted-and-resumed run still
+    # knows which old step is the best and keeps protecting it
+    retention = RetentionPolicy(args.keep, args.keep_best,
+                                directory=ckpt_dir)
     metrics = None
+    preempted = False
+
+    def save_with_position(step: int) -> None:
+        """Checkpoint + stream-position sidecar + retention GC, as one
+        operation — every save leaves a resumable, bounded directory."""
+        nonlocal last_saved
+        ckpt.save_checkpoint(ckpt_dir, state, step=step)
+        save_position(ckpt_dir, step, stream_pos, seed=tc.seed)
+        last_saved = step
+        retention.apply(ckpt_dir, protect=(last_saved,))
+
+    # fault injection for the chaos tests/smoke: a real signal/fault
+    # fired at a pinned step, flowing through the real recovery paths
+    chaos_step = None
+    if args.chaos:
+        from dexiraft_tpu.resilience import chaos as chaos_lib
+
+        chaos_step = chaos_lib.parse_spec(args.chaos)
+
     # device-side double buffering: batch N+1 is device_put with the
     # step's input shardings while step N runs — the synchronous
-    # host->device hop leaves the critical path (data/prefetch.py)
-    batches = prefetch_to_device(loader, mesh, depth=tc.prefetch_depth)
+    # host->device hop leaves the critical path (data/prefetch.py).
+    # The stream starts at the checkpointed position (exact resume).
+    batches = prefetch_to_device(
+        loader.batches(start_epoch=stream_pos.epoch,
+                       start_offset=stream_pos.offset),
+        mesh, depth=tc.prefetch_depth, pipeline_stats=loader.stats)
+    preempt = PreemptionHandler()
     try:
-        with mesh:
+        with preempt, mesh:
             for batch in batches:
                 # range-based (not equality) so resumed runs landing inside
                 # the window still profile, and stop only pairs with a start
@@ -298,7 +371,18 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     prof_active = True
                 state, metrics = step_fn(state, batch)
                 total_steps += 1
+                # note: advanced on CONSUMPTION, never rewound by a
+                # rollback — the stream continues past a divergent
+                # window instead of replaying it. The loader publishes
+                # each yielded batch's true (epoch, offset), so batches
+                # it dropped (zero survivors) can never desync the
+                # checkpointed position from the actual stream
+                epoch_b, offset_b = loader.positions.popleft()
+                stream_pos = StreamPosition(epoch_b, offset_b).advance(
+                    1, batches_per_epoch)
                 logger.push(metrics)
+                if chaos_step is not None:
+                    chaos_step(total_steps)
                 if prof_active and total_steps >= prof_stop:
                     jax.block_until_ready(metrics["loss"])
                     jax.profiler.stop_trace()
@@ -317,19 +401,26 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     state_ok = bool(jax.device_get(
                         metrics.get("state_finite", True)))
                     if guard.poisoned(loss_v, state_ok):
-                        guard.consume_rollback(loss_v, state_ok,
-                                               f"step {total_steps}",
-                                               last_saved)
-                        state = ckpt.restore_checkpoint(ckpt_dir, state,
-                                                        step=last_saved)
+                        guard.consume_rollback(
+                            loss_v, state_ok, f"step {total_steps}",
+                            last_saved, ckpt_dir=ckpt_dir)
+                        # verified restore: should the rollback target
+                        # itself turn out damaged, fall back further
+                        # rather than crash mid-recovery
+                        state, last_saved = restore_verified(
+                            ckpt_dir, state, step=last_saved)
                         # the restored state has no fresh metrics; leaving
                         # the poisoned step's here would make the END-OF-RUN
                         # guard below veto the final save of a GOOD state
                         metrics = None
+                        # printed AFTER the restore with the step it
+                        # actually landed on — a verified fallback past
+                        # the nominal target must not tell the operator
+                        # to inspect a checkpoint that was never used
                         print(f"[guard] loss {loss_v:.4g} "
                               f"(state_finite={state_ok}) at step "
-                              f"{total_steps}; restored step {last_saved} "
-                              f"(rollback {guard.rollbacks}/"
+                              f"{total_steps}; restored {ckpt_dir} step "
+                              f"{last_saved} (rollback {guard.rollbacks}/"
                               f"{args.max_rollbacks})")
                         # relative rewind: the logger's counter is per-run
                         # (starts at 0 on resume), so subtract the rolled-
@@ -339,11 +430,51 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                         total_steps = last_saved
                         continue  # never checkpoint on a rollback step
 
+                if preempt.triggered:
+                    # graceful preemption: ONE emergency save at the
+                    # step boundary (guard-checked — preemption is not a
+                    # license to persist a poisoned state), then leave
+                    # the loop; the position sidecar makes the later
+                    # --resume continue the exact sample sequence
+                    preempted = True
+                    if args.on_preempt == "save":
+                        poisoned = False
+                        if not args.no_guard and metrics is not None:
+                            loss_v = float(jax.device_get(metrics["loss"]))
+                            state_ok = bool(jax.device_get(
+                                metrics.get("state_finite", True)))
+                            poisoned = guard.poisoned(loss_v, state_ok)
+                        if poisoned:
+                            print(f"[preempt] state at step {total_steps} "
+                                  f"is poisoned; NOT saving — latest good "
+                                  f"checkpoint remains step {last_saved}")
+                        else:
+                            save_with_position(total_steps)
+                            print(f"[preempt] emergency checkpoint: "
+                                  f"{ckpt_dir} step {total_steps} (data "
+                                  f"stream epoch {stream_pos.epoch}, batch "
+                                  f"{stream_pos.offset}); resume with "
+                                  f"--resume")
+                    else:
+                        print(f"[preempt] --on_preempt abort: stopping "
+                              f"without saving (latest checkpoint: step "
+                              f"{last_saved})")
+                    break
+
                 if total_steps % tc.val_freq == 0:
-                    ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
-                    last_saved = total_steps
+                    save_with_position(total_steps)
                     for vname in tc.validation:
-                        logger.write_dict(validate(vname), step=total_steps)
+                        results = validate(vname)
+                        logger.write_dict(results, step=total_steps)
+                        # retention's quality signal: the first EPE-like
+                        # scalar of the FIRST validation set (lower =
+                        # better) ranks this checkpoint for --keep_best
+                        if vname == tc.validation[0] and results:
+                            epe_keys = [k for k in results if "epe" in k
+                                        or k == vname]
+                            if epe_keys:
+                                retention.note_score(total_steps,
+                                                     results[epe_keys[0]])
                 if total_steps >= tc.num_steps:
                     break
     finally:
@@ -358,9 +489,11 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         print(f"[profile] trace (truncated at end of run) -> {prof_dir}")
     # the final save honors the guard too: a nan that arrives between
     # guard checks and the end of the run must not become the latest
-    # checkpoint that --resume/eval would silently load
-    final_ok = True
-    if not args.no_guard and metrics is not None:
+    # checkpoint that --resume/eval would silently load. A preempted
+    # run already made its one emergency save (or declined to) inside
+    # the loop.
+    final_ok = not preempted
+    if final_ok and not args.no_guard and metrics is not None:
         loss_v = float(jax.device_get(metrics["loss"]))
         state_ok = bool(jax.device_get(metrics.get("state_finite", True)))
         if guard.poisoned(loss_v, state_ok):
@@ -370,10 +503,16 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                   f"skipping the final save — latest good checkpoint "
                   f"remains step {last_saved}")
     if final_ok:
-        ckpt.save_checkpoint(ckpt_dir, state, step=total_steps)
+        save_with_position(total_steps)
     logger.close()
-    print(f"[prefetch] {batches.stats.summary()}")
-    print(f"Done: {total_steps} steps -> {ckpt_dir}")
+    print(f"[prefetch] {batches.summary()}")
+    if loader.stats.faults:
+        print(f"[pipeline] {loader.stats.summary()}")
+    if preempted:
+        print(f"Preempted ({preempt.signal_name}) at step {total_steps} "
+              f"-> {ckpt_dir}")
+    else:
+        print(f"Done: {total_steps} steps -> {ckpt_dir}")
 
 
 def main(argv=None) -> None:
