@@ -1,0 +1,18 @@
+"""Static + runtime guards against the JAX/TPU footgun class.
+
+Two halves, deliberately decoupled:
+
+- ``jaxlint`` — pure-stdlib AST linter (no jax import) run by
+  ``scripts/lint_gate.py`` as the pre-pytest CI gate. Import it by file
+  path or as ``dexiraft_tpu.analysis.jaxlint``.
+- ``guards`` — the runtime side (imports jax): ``strict_mode()`` arms
+  ``jax.transfer_guard`` plus a recompile-count sentinel so steady-state
+  retraces and implicit host transfers raise instead of silently
+  degrading throughput; ``RecompileWatch`` is the observe-only variant
+  that powers the non-strict drift warnings.
+
+This ``__init__`` imports nothing so the lint gate and tests can load
+``jaxlint`` without paying (or even having) the jax import.
+
+See docs/static_analysis.md for the rule catalog and --strict semantics.
+"""

@@ -1,0 +1,169 @@
+"""Runtime guards: the dynamic half of the jaxlint story.
+
+jaxlint (the static half) catches the footguns visible in source text;
+this module catches the two that only exist at run time:
+
+- **steady-state recompiles** — a shape/dtype drift after warmup silently
+  retraces the step and erases the throughput the benches measured. The
+  process-wide `compile_count()` counter (fed by jax.monitoring's
+  ``/jax/core/compile/backend_compile_duration`` event — one firing per
+  backend compile, cache hits excluded) makes "compile count must stay
+  flat after warmup" an assertable property.
+- **implicit host<->device transfers** — a ``float()``/``np.asarray()``
+  on the wrong value syncs the pipeline every step.
+  ``jax.transfer_guard("disallow")`` turns those into errors while the
+  sanctioned explicit spellings (``jax.device_put``/``jax.device_get``)
+  pass.
+
+``strict_mode()`` arms both and RAISES on violation — wired behind
+``--strict`` in train_cli/eval_cli and always-on for the steady-state
+window of serve_bench/train_bench. ``RecompileWatch`` observes without
+raising — it powers the one-line drift warning non-strict runs emit.
+
+Monitoring listeners cannot be unregistered (jax.monitoring has no
+per-listener removal), so ONE module-level listener is installed lazily
+on first use and only ever increments a counter; entering/leaving
+strict_mode snapshots it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Iterator, Optional
+
+import jax
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """Raised when a strict_mode region compiles past its pinned budget."""
+
+
+def _listener(event: str, durations: float, **_kw) -> None:
+    global _count
+    if event == _COMPILE_EVENT:
+        _count += 1
+
+
+def _ensure_listener() -> None:
+    global _installed
+    with _lock:
+        if not _installed:
+            jax.monitoring.register_event_duration_secs_listener(_listener)
+            _installed = True
+
+
+def compile_count() -> int:
+    """Backend compiles observed in this process so far (monotone).
+
+    Counts actual XLA backend compiles — executable-cache hits and
+    persistent-cache deserializations do not fire the event twice for
+    the same executable, so a flat count across a window means XLA
+    re-used executables for every dispatch in it.
+    """
+    _ensure_listener()
+    return _count
+
+
+class RecompileWatch:
+    """Observe-only recompile sentinel for non-strict runs.
+
+    Usage::
+
+        watch = RecompileWatch("train")
+        ... warmup (compiles expected) ...
+        watch.mark_warm()
+        ... steady state ...
+        watch.warn_if_drifted()   # one line on stderr, once, if any
+                                  # post-warmup compile happened
+
+    ``mark_warm()`` may be called repeatedly (e.g. once per new bucket
+    the caller *expects* to compile); drift is measured from the last
+    call.
+    """
+
+    def __init__(self, label: str = "run", budget: int = 0):
+        self.label = label
+        self.budget = budget
+        _ensure_listener()
+        self._warm_at: Optional[int] = None
+        self._warned = False
+
+    def mark_warm(self) -> None:
+        self._warm_at = compile_count()
+
+    @property
+    def drift(self) -> int:
+        """Compiles since mark_warm() (0 before it is called)."""
+        if self._warm_at is None:
+            return 0
+        return compile_count() - self._warm_at
+
+    def check(self, budget: Optional[int] = None) -> None:
+        """Raise :class:`RecompileBudgetExceeded` when drift exceeds the
+        budget (defaults to the watch's own). The strict-mode teeth; the
+        observe-only path uses :meth:`warn_if_drifted` instead."""
+        budget = self.budget if budget is None else budget
+        if self.drift > budget:
+            raise RecompileBudgetExceeded(
+                f"[guards] {self.label}: {self.drift} backend compile(s) "
+                f"in a strict region with budget {budget} — steady state "
+                f"retraced (shape/dtype drift). Enable jax.log_compiles() "
+                f"to see what; docs/static_analysis.md has the playbook")
+
+    def warn_if_drifted(self, file=None) -> bool:
+        """One-line, once-only warning when post-warmup compiles exist.
+
+        Returns True if drift was (ever) reported — callers embedding
+        this in a loop get the cadence for free.
+        """
+        d = self.drift
+        if d > 0 and not self._warned:
+            self._warned = True
+            print(f"[guards] {self.label}: {d} recompile(s) after warmup "
+                  f"— shape/dtype drift is erasing throughput; rerun "
+                  f"with --strict to fail fast (docs/static_analysis.md)",
+                  file=file or sys.stderr)
+        return self._warned
+
+
+@contextlib.contextmanager
+def strict_mode(compile_budget: int = 0,
+                transfer: str = "disallow",
+                label: str = "strict") -> Iterator[RecompileWatch]:
+    """Arm transfer_guard + the recompile sentinel for a region.
+
+    Inside the region:
+      - implicit host<->device transfers raise immediately (jax's own
+        transfer_guard error names the offending aval); explicit
+        ``jax.device_put``/``jax.device_get`` still pass,
+      - backend compiles are counted; leaving the region (or calling
+        ``check()`` on the yielded watch) raises
+        :class:`RecompileBudgetExceeded` if more than ``compile_budget``
+        happened.
+
+    ``compile_budget=0`` is the steady-state contract: run warmup
+    *before* entering. A warmup-inclusive region should pass its known
+    compile count (e.g. one per serve bucket).
+
+    ``transfer`` is any jax transfer-guard level ("allow", "log",
+    "disallow"); "log" is the diagnose-without-failing mode.
+
+    The yielded object is a :class:`RecompileWatch` pre-marked at entry,
+    so ``watch.drift`` is live inside the region, ``watch.check()`` can
+    assert mid-region (e.g. per bench rep), and ``watch.mark_warm()``
+    can absorb an *expected* compile (a planned new bucket) without
+    widening the budget for the unplanned ones.
+    """
+    watch = RecompileWatch(label, budget=compile_budget)
+    watch.mark_warm()
+    with jax.transfer_guard(transfer):
+        yield watch
+    watch.check()

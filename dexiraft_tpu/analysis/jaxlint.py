@@ -1,0 +1,888 @@
+"""jaxlint — AST analyzer for the JAX/TPU footguns this codebase bans.
+
+The failure class that erases throughput wins is invisible in review:
+a `float()` on the wrong value silently syncs the pipeline every step, a
+reused PRNG key correlates two samplers, a jit without donation doubles
+HBM for the train state, a timed region without a device sync measures
+dispatch instead of compute (the bench then "improves" when the model
+gets slower to enqueue). These are all *textual* patterns — this module
+finds them statically so `scripts/lint_gate.py` can fail the commit
+instead of a benchmark failing the quarter.
+
+Pure stdlib (ast) on purpose: the gate must run pre-pytest in ~a second
+with no jax import, and `scripts/lint_gate.py` loads this file by path
+so even `dexiraft_tpu/__init__` stays out of the loop.
+
+Rule catalog (docs/static_analysis.md has the long-form version):
+
+  JL001 tracer-host-sync   np.asarray / np.array / jax.device_get /
+                           .item() / .tolist() / float(param) inside a
+                           jitted function — concretizes a tracer (trace
+                           error at best, silent per-call constant at
+                           worst).
+  JL002 key-reuse          a PRNG key variable consumed by >= 2
+                           jax.random calls without an intervening
+                           split/fold_in rebind, or consumed inside a
+                           loop while bound outside it.
+  JL003 tracer-branch      Python `if`/`while` on a jitted function's
+                           (non-static) array argument — trace-time
+                           concretization / a retrace per distinct value.
+                           `.shape`/`.ndim`/`.dtype` and `is None`
+                           checks are static and exempt.
+  JL004 untimed-bench      a perf_counter()-delimited span in a bench
+                           script that dispatches device work but never
+                           syncs (block_until_ready / device_get /
+                           scalar fetch) before reading the timer —
+                           times async dispatch, not compute.
+  JL005 f64-literal        an explicit float64 dtype in a jax-importing
+                           file — TPUs have no f64; under the default
+                           x64-disabled config this is a silent
+                           downcast, under x64 a silent 2x slowdown.
+  JL006 jit-no-donate      jit over a state-threading function (a
+                           leading state/opt_state/carry parameter)
+                           without donate_argnums — the old state stays
+                           resident and doubles the step's HBM.
+  JL007 implicit-fetch     float()/int()/np.asarray() directly on the
+                           result of a jitted/step function — an
+                           *implicit* device->host sync. Use
+                           jax.device_get(...) so the sync is explicit,
+                           grep-able, and transfer-guard-clean
+                           (analysis.guards.strict_mode).
+  JL008 loop-sync          an unconditional per-iteration host sync
+                           (device_get / block_until_ready / .item())
+                           inside a for/while in library train/eval/
+                           serve paths — syncs belong on a cadence
+                           (`if step % N == 0`), not in the loop body.
+  JL009 jit-in-loop        jax.jit(...) constructed inside a loop body —
+                           a fresh wrapper (and a retrace) per
+                           iteration; hoist it.
+
+Suppression: `# jaxlint: disable=JL00X` on the offending line, or a
+reviewed entry in analysis/baseline.json (see lint_gate.py). Baseline
+entries match on (rule, path, stripped source line) so they survive
+unrelated line-number churn but die with the code they excused.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "JL000": "syntax-error",
+    "JL001": "tracer-host-sync",
+    "JL002": "key-reuse",
+    "JL003": "tracer-branch",
+    "JL004": "untimed-bench",
+    "JL005": "f64-literal",
+    "JL006": "jit-no-donate",
+    "JL007": "implicit-fetch",
+    "JL008": "loop-sync",
+    "JL009": "jit-in-loop",
+}
+
+# dotted names that mean "jax.jit" after alias resolution
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit",
+              "jax.experimental.pjit.pjit"}
+# jax.random producers (return a key without consuming a key argument)
+_KEY_PRODUCERS = {"PRNGKey", "key"}
+# jax.random functions that REBIND rather than leak (their results are
+# fresh keys; passing a key to them still consumes it)
+_KEY_DERIVERS = {"split", "fold_in", "clone"}
+# first-parameter names that mark a jitted function as state-threading
+_STATE_PARAMS = {"state", "train_state", "opt_state", "carry"}
+# explicit host-sync calls (the sanctioned spellings)
+_SYNC_FUNCS = {"jax.block_until_ready", "jax.device_get"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# timer sources that open/close a JL004 span
+_TIMER_FUNCS = {"time.perf_counter", "time.monotonic", "time.time"}
+# engine methods whose call dispatches device work
+_ENGINE_METHODS = {"stream", "run_batch"}
+_MAKE_STEP_RE = re.compile(r"^make_\w*step$")
+_FN_PARAM_RE = re.compile(r"^(fn|\w*_fn)$")
+_DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str  # stripped source line — the baseline match key
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{RULES[self.rule]}] {self.message}")
+
+    def baseline_entry(self) -> dict:
+        """Ready-to-paste analysis/baseline.json allow entry."""
+        return {"rule": self.rule, "path": self.path,
+                "snippet": self.snippet, "reason": "<why this is ok>"}
+
+
+# --------------------------------------------------------------------------
+# module context: import aliases, jitted functions, device-valued names
+# --------------------------------------------------------------------------
+
+
+class _Module:
+    def __init__(self, tree: ast.Module, src: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.lines = src.splitlines()
+        self.aliases: Dict[str, str] = {}
+        self._collect_aliases(tree)
+        # function name -> (FunctionDef, jitted?, donate?, static_params)
+        self.defs: Dict[str, ast.AST] = {}
+        self.jitted: Set[ast.AST] = set()
+        self.jit_sites: List[Tuple[ast.AST, ast.AST, bool]] = []
+        # names bound to device-dispatching callables / engines
+        self.device_vars: Set[str] = set()
+        self.engine_vars: Set[str] = set()
+        self._collect_defs(tree)
+        self._collect_bindings(tree)
+
+    # -- imports -----------------------------------------------------------
+
+    def _collect_aliases(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    self.aliases[a.asname or root] = (
+                        a.name if a.asname else root)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain to a dotted path via aliases."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.dotted(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    @property
+    def imports_jax(self) -> bool:
+        return any(v == "jax" or v.startswith("jax.")
+                   for v in self.aliases.values())
+
+    # -- jit discovery -----------------------------------------------------
+
+    def _is_jit(self, node: ast.AST) -> bool:
+        return self.dotted(node) in _JIT_NAMES
+
+    def _jit_call_info(self, call: ast.Call):
+        """(wrapped_fn_node_or_name, has_donate, static_argnums) for a
+        Call that applies jit — either jax.jit(...) directly or
+        functools.partial(jax.jit, ...)."""
+        kwargs = {k.arg for k in call.keywords if k.arg}
+        statics = self._static_argnums(call)
+        if self._is_jit(call.func):
+            wrapped = call.args[0] if call.args else None
+            return wrapped, bool(kwargs & {"donate_argnums",
+                                           "donate_argnames"}), statics
+        if (self.dotted(call.func) in ("functools.partial", "partial")
+                and call.args and self._is_jit(call.args[0])):
+            return None, bool(kwargs & {"donate_argnums",
+                                        "donate_argnames"}), statics
+        return NotImplemented, False, ()
+
+    @staticmethod
+    def _static_argnums(call: ast.Call) -> Tuple[int, ...]:
+        for k in call.keywords:
+            if k.arg == "static_argnums":
+                v = k.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int))
+        return ()
+
+    def _collect_defs(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+                for dec in node.decorator_list:
+                    if self._is_jit(dec):
+                        self.jitted.add(node)
+                        self.jit_sites.append((dec, node, False))
+                    elif isinstance(dec, ast.Call):
+                        wrapped, donate, statics = self._jit_call_info(dec)
+                        if wrapped is not NotImplemented:
+                            self.jitted.add(node)
+                            node._jl_static = statics  # type: ignore
+                            self.jit_sites.append((dec, node, donate))
+
+    def _collect_bindings(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not targets or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            callee = self.dotted(call.func)
+            wrapped, donate, statics = self._jit_call_info(call)
+            if wrapped is not NotImplemented:
+                # x = jax.jit(f[, ...]) — x dispatches device work; f is
+                # traced code
+                self.device_vars.update(targets)
+                if isinstance(wrapped, ast.Name) \
+                        and wrapped.id in self.defs:
+                    fn = self.defs[wrapped.id]
+                    self.jitted.add(fn)
+                    fn._jl_static = statics  # type: ignore
+                    self.jit_sites.append((call, fn, donate))
+                elif isinstance(wrapped, ast.Lambda):
+                    self.jitted.add(wrapped)
+                    self.jit_sites.append((call, wrapped, donate))
+            elif callee and _MAKE_STEP_RE.match(callee.split(".")[-1]):
+                self.device_vars.update(targets)
+            elif callee and callee.split(".")[-1] == "InferenceEngine":
+                self.engine_vars.update(targets)
+        # jitted defs dispatch when called by name
+        for fn in self.jitted:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.device_vars.add(fn.name)
+
+    # -- helpers -----------------------------------------------------------
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        m = _DISABLE_RE.search(self.snippet(line))
+        return bool(m) and rule in m.group(1)
+
+
+class _Linter:
+    def __init__(self, mod: _Module, rules: Optional[Set[str]] = None):
+        self.mod = mod
+        self.rules = rules or set(RULES)
+        self.findings: List[Finding] = []
+
+    def flag(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 0)
+        if self.mod.suppressed(line, rule):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.path, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            snippet=self.mod.snippet(line)))
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        mod = self.mod
+        self._rule_jl006()
+        if mod.imports_jax:
+            self._rule_jl005()
+        for fn in mod.jitted:
+            self._rule_jl001(fn)
+            self._rule_jl003(fn)
+        # sequential, scope-aware rules
+        self._rule_jl002(mod.tree.body, loop_depth=0, keys={})
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._rule_jl002(node.body, loop_depth=0, keys={})
+        base = os.path.basename(mod.path)
+        if "bench" in base and mod.path.replace(os.sep, "/").startswith(
+                "scripts/"):
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._rule_jl004(node)
+        self._rule_jl007(mod.tree)
+        rel = mod.path.replace(os.sep, "/")
+        if (rel.startswith(("dexiraft_tpu/train/", "dexiraft_tpu/eval/",
+                            "dexiraft_tpu/serve/"))
+                or rel in ("dexiraft_tpu/train_cli.py",
+                           "dexiraft_tpu/eval_cli.py")):
+            self._rule_jl008(mod.tree)
+        self._rule_jl009(mod.tree)
+        # statement flattening + nested loops can visit a node via more
+        # than one ancestor — report each site once
+        seen = set()
+        unique = []
+        for f in self.findings:
+            k = (f.rule, f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                unique.append(f)
+        return unique
+
+    # -- JL001: host sync on tracers inside jitted code --------------------
+
+    def _rule_jl001(self, fn: ast.AST) -> None:
+        params = _param_names(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.mod.dotted(node.func)
+                if callee in ("numpy.asarray", "numpy.array",
+                              "jax.device_get"):
+                    self.flag("JL001", node,
+                              f"{callee}() inside jitted code concretizes "
+                              f"the tracer (host round-trip or trace error)")
+                elif callee in ("float", "int", "bool") and node.args:
+                    root = _root_name(node.args[0])
+                    if root in params:
+                        self.flag("JL001", node,
+                                  f"{callee}() on traced argument "
+                                  f"'{root}' inside jitted code")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in ("item", "tolist")
+                      and not node.args):
+                    self.flag("JL001", node,
+                              f".{node.func.attr}() inside jitted code "
+                              f"forces a host sync on a tracer")
+
+    # -- JL002: PRNG key reuse --------------------------------------------
+
+    def _rule_jl002(self, stmts: Sequence[ast.stmt], loop_depth: int,
+                    keys: Dict[str, dict]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # fresh scope, visited separately
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._jl002_scan_expr(stmt.iter, loop_depth, keys)
+                tgt_names = _target_names(stmt.target)
+                iter_is_keys = self._is_key_call(stmt.iter, derive_ok=True)
+                for t in tgt_names:
+                    if iter_is_keys:
+                        keys[t] = {"uses": 0, "depth": loop_depth + 1,
+                                   "line": stmt.lineno}
+                    else:
+                        keys.pop(t, None)
+                self._rule_jl002(stmt.body + stmt.orelse,
+                                 loop_depth + 1, keys)
+            elif isinstance(stmt, ast.While):
+                self._jl002_scan_expr(stmt.test, loop_depth, keys)
+                self._rule_jl002(stmt.body + stmt.orelse,
+                                 loop_depth + 1, keys)
+            elif isinstance(stmt, ast.If):
+                self._jl002_scan_expr(stmt.test, loop_depth, keys)
+                self._rule_jl002(stmt.body, loop_depth, keys)
+                self._rule_jl002(stmt.orelse, loop_depth, keys)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._jl002_scan_expr(item.context_expr, loop_depth, keys)
+                self._rule_jl002(stmt.body, loop_depth, keys)
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._rule_jl002(blk, loop_depth, keys)
+                for h in stmt.handlers:
+                    self._rule_jl002(h.body, loop_depth, keys)
+            elif isinstance(stmt, ast.Assign):
+                self._jl002_scan_expr(stmt.value, loop_depth, keys)
+                fresh = self._is_key_call(stmt.value, derive_ok=True)
+                for t in stmt.targets:
+                    for name in _target_names(t):
+                        if fresh:
+                            keys[name] = {"uses": 0, "depth": loop_depth,
+                                          "line": stmt.lineno}
+                        else:
+                            keys.pop(name, None)
+            else:
+                self._jl002_scan_expr(stmt, loop_depth, keys)
+
+    def _is_key_call(self, node: ast.AST, derive_ok: bool) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        callee = self.mod.dotted(node.func) or ""
+        if not callee.startswith("jax.random."):
+            return False
+        fn = callee.split(".")[-1]
+        return fn in _KEY_PRODUCERS or (derive_ok and fn in _KEY_DERIVERS)
+
+    def _jl002_scan_expr(self, node: ast.AST, loop_depth: int,
+                         keys: Dict[str, dict]) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = self.mod.dotted(sub.func) or ""
+            if not callee.startswith("jax.random."):
+                continue
+            fn = callee.split(".")[-1]
+            if fn in _KEY_PRODUCERS:
+                continue  # produces, never consumes
+            args = list(sub.args) + [k.value for k in sub.keywords]
+            for arg in args:
+                if not isinstance(arg, ast.Name) or arg.id not in keys:
+                    continue
+                rec = keys[arg.id]
+                rec["uses"] += 1
+                if rec["uses"] == 2:
+                    self.flag(
+                        "JL002", sub,
+                        f"PRNG key '{arg.id}' (bound line {rec['line']}) "
+                        f"consumed again without split/fold_in — "
+                        f"correlated randomness")
+                elif rec["uses"] == 1 and loop_depth > rec["depth"]:
+                    rec["uses"] = 2  # don't double-report
+                    self.flag(
+                        "JL002", sub,
+                        f"PRNG key '{arg.id}' bound outside this loop is "
+                        f"consumed every iteration — identical randomness "
+                        f"per pass; split per iteration instead")
+
+    # -- JL003: tracer-dependent Python branching --------------------------
+
+    def _rule_jl003(self, fn: ast.AST) -> None:
+        params = _param_names(fn)
+        statics = getattr(fn, "_jl_static", ())
+        ordered = _positional_params(fn)
+        static_names = {ordered[i] for i in statics if i < len(ordered)}
+        dynamic = params - static_names
+        if not dynamic or not isinstance(getattr(fn, "body", None), list):
+            return
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.If, ast.While)):
+                names = _dynamic_refs(stmt.test, dynamic)
+                if names:
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    self.flag(
+                        "JL003", stmt,
+                        f"python `{kind}` on traced argument(s) "
+                        f"{sorted(names)} inside jitted code — trace-time "
+                        f"concretization or a retrace per value; use "
+                        f"lax.cond/jnp.where, or mark the arg static")
+
+    # -- JL004: timed bench spans without a sync ---------------------------
+
+    def _rule_jl004(self, fn: ast.AST) -> None:
+        self._jl004_block(fn.body)
+
+    def _jl004_block(self, stmts: Sequence[ast.stmt]) -> None:
+        opens: Dict[str, Tuple[int, ast.stmt]] = {}
+        for i, stmt in enumerate(stmts):
+            # close: any `<timer>() - t0` inside this statement
+            closed = set()
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Sub)
+                        and isinstance(node.right, ast.Name)
+                        and node.right.id in opens
+                        and isinstance(node.left, ast.Call)
+                        and self.mod.dotted(node.left.func) in _TIMER_FUNCS):
+                    closed.add(node.right.id)
+            for name in closed:
+                start_i, open_stmt = opens.pop(name)
+                span = list(stmts[start_i + 1:i])
+                if (span and self._dispatches_device(span)
+                        and not self._has_sync(span)):
+                    self.flag(
+                        "JL004", open_stmt,
+                        f"timed region ('{name}', closed line "
+                        f"{stmt.lineno}) dispatches device work but never "
+                        f"syncs before reading the timer — add "
+                        f"block_until_ready/device_get (or a scalar "
+                        f"device_get fetch) inside the span")
+            # open: t = time.perf_counter()
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)
+                    and self.mod.dotted(stmt.value.func) in _TIMER_FUNCS):
+                opens[stmt.targets[0].id] = (i, stmt)
+            # recurse into nested blocks for spans local to them
+            for blk in _sub_blocks(stmt):
+                self._jl004_block(blk)
+
+    def _dispatches_device(self, stmts: Iterable[ast.stmt]) -> bool:
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Name)
+                        and f.id in self.mod.device_vars):
+                    return True
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _ENGINE_METHODS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in self.mod.engine_vars):
+                    return True
+        return False
+
+    def _has_sync(self, stmts: Iterable[ast.stmt]) -> bool:
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.mod.dotted(node.func)
+                if callee in _SYNC_FUNCS or callee in (
+                        "float", "int", "numpy.asarray", "numpy.array"):
+                    return True
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_METHODS):
+                    return True
+        return False
+
+    # -- JL005: explicit float64 ------------------------------------------
+
+    _F64 = {"numpy.float64", "jax.numpy.float64", "numpy.double"}
+    _F64_STR = {"float64", "f8", "double"}
+
+    def _rule_jl005(self) -> None:
+        def is_f64(node: ast.AST) -> bool:
+            d = self.mod.dotted(node)
+            if d in self._F64:
+                return True
+            return (isinstance(node, ast.Constant)
+                    and node.value in self._F64_STR)
+
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.mod.dotted(node.func) in self._F64:
+                self.flag("JL005", node,
+                          "float64 constructor in a jax-importing module "
+                          "— TPUs have no f64 (silent downcast under the "
+                          "default config)")
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args and is_f64(node.args[0])):
+                self.flag("JL005", node,
+                          ".astype(float64) in a jax-importing module")
+                continue
+            for kw in node.keywords:
+                if kw.arg == "dtype" and is_f64(kw.value):
+                    self.flag("JL005", node,
+                              "dtype=float64 in a jax-importing module")
+
+    # -- JL006: jit without donation on state-threading functions ----------
+
+    def _rule_jl006(self) -> None:
+        for site, fn, donate in self.mod.jit_sites:
+            if donate:
+                continue
+            ordered = _positional_params(fn)
+            leading = set(ordered[:3])
+            hit = leading & _STATE_PARAMS
+            if hit:
+                self.flag(
+                    "JL006", site,
+                    f"jit over state-threading function (params "
+                    f"{sorted(hit)}) without donate_argnums — the old "
+                    f"state stays resident and doubles step HBM")
+
+    # -- JL007: implicit device->host fetch --------------------------------
+
+    def _rule_jl007(self, tree: ast.AST) -> None:
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        for scope in scopes:
+            if scope in self.mod.jitted:
+                continue  # JL001's domain
+            device_locals = set(self.mod.device_vars)
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                device_locals |= {p for p in _param_names(scope)
+                                  if _FN_PARAM_RE.match(p)}
+            # names assigned from device-fn calls (incl tuple unpack)
+            device_vals: Set[str] = set()
+            for stmt in _own_statements(scope):
+                if (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Call)
+                        and self._is_device_call(stmt.value, device_locals)):
+                    for t in stmt.targets:
+                        device_vals.update(_target_names(t))
+            for stmt in _own_statements(scope):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or not node.args:
+                        continue
+                    callee = self.mod.dotted(node.func)
+                    if callee not in ("float", "int", "numpy.asarray",
+                                      "numpy.array"):
+                        continue
+                    arg = node.args[0]
+                    if (isinstance(arg, ast.Call)
+                            and self._is_device_call(arg, device_locals)):
+                        self.flag(
+                            "JL007", node,
+                            f"{callee}() directly on a device computation "
+                            f"result — wrap in jax.device_get(...) so the "
+                            f"host sync is explicit and "
+                            f"transfer-guard-clean")
+                        continue
+                    root = _root_name(arg)
+                    if root in device_vals:
+                        self.flag(
+                            "JL007", node,
+                            f"{callee}() on '{root}' (result of a jitted/"
+                            f"step call) — an implicit device->host sync; "
+                            f"use jax.device_get(...)")
+
+    def _is_device_call(self, call: ast.Call, device_locals: Set[str]) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in device_locals:
+            return True
+        return False
+
+    # -- JL008: unconditional in-loop sync in library paths ----------------
+
+    def _rule_jl008(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While)):
+                self._jl008_body(node.body)
+
+    def _jl008_body(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                continue  # cadence-gated syncs are the sanctioned shape
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.FunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.mod.dotted(node.func)
+                if callee in _SYNC_FUNCS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args):
+                    self.flag(
+                        "JL008", node,
+                        f"unconditional per-iteration host sync "
+                        f"({callee or node.func.attr}) in a library "
+                        f"train/eval/serve loop — gate it on a cadence "
+                        f"(`if step % N == 0`)")
+
+    # -- JL009: jit constructed inside a loop ------------------------------
+
+    def _rule_jl009(self, tree: ast.AST) -> None:
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for stmt in loop.body + loop.orelse:
+                for node in ast.walk(stmt):
+                    if (isinstance(node, ast.Call)
+                            and self.mod._is_jit(node.func)):
+                        self.flag(
+                            "JL009", node,
+                            "jax.jit(...) constructed inside a loop — a "
+                            "fresh wrapper (and retrace) per iteration; "
+                            "hoist it out")
+
+
+# --------------------------------------------------------------------------
+# AST utilities
+# --------------------------------------------------------------------------
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n != "self"}
+
+
+def _positional_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args if p.arg != "self"]
+
+
+def _target_names(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(node, ast.Starred):
+        return _target_names(node.value)
+    return []
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Subscript, ast.Attribute, ast.Starred)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+
+def _dynamic_refs(test: ast.AST, params: Set[str]) -> Set[str]:
+    """Names in `test` referencing params *dynamically* (value-dependent).
+    `.shape`-style attribute reads and `is (not) None` checks are static
+    at trace time and exempt."""
+    out: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return  # x.shape[...] is static
+            visit(node.value)
+        elif isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return  # `x is None`
+            visit(node.left)
+            for c in node.comparators:
+                visit(c)
+        elif isinstance(node, ast.Name):
+            if node.id in params:
+                out.add(node.id)
+        else:
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+    visit(test)
+    return out
+
+
+def _sub_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []  # fresh scope — visited on its own
+    blocks = []
+    for attr in ("body", "orelse", "finalbody"):
+        blk = getattr(stmt, attr, None)
+        if isinstance(blk, list) and blk and isinstance(blk[0], ast.stmt):
+            blocks.append(blk)
+    for h in getattr(stmt, "handlers", []) or []:
+        blocks.append(h.body)
+    return blocks
+
+
+def _own_statements(scope: ast.AST) -> List[ast.stmt]:
+    """Statements of `scope` excluding nested function bodies."""
+    out: List[ast.stmt] = []
+
+    def collect(stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.append(s)
+            for blk in _sub_blocks(s):
+                collect(blk)
+
+    collect(scope.body)
+    return out
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one module's source text. `path` should be repo-relative with
+    forward slashes — several rules scope on it."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="JL000", path=path, line=e.lineno or 0,
+                        col=e.offset or 0,
+                        message=f"syntax error: {e.msg}", snippet="")]
+    mod = _Module(tree, src, path)
+    return _Linter(mod, rules).run()
+
+
+def lint_file(abspath: str, relpath: str,
+              rules: Optional[Set[str]] = None) -> List[Finding]:
+    with open(abspath, encoding="utf-8") as f:
+        return lint_source(f.read(), relpath, rules)
+
+
+@dataclasses.dataclass
+class Baseline:
+    """analysis/baseline.json: the gate's determinism config.
+
+    exclude — ruff-style glob list of repo-relative paths the linter
+    skips entirely (archived one-off probe scripts).
+    allow   — reviewed findings, matched on (rule, path, stripped source
+    line); each entry carries a human `reason`. An entry that matches
+    nothing is itself an error (stale excuses rot)."""
+
+    exclude: List[str] = dataclasses.field(default_factory=list)
+    allow: List[dict] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        return cls(exclude=list(raw.get("exclude", [])),
+                   allow=list(raw.get("allow", [])))
+
+    def excludes(self, relpath: str) -> bool:
+        return any(fnmatch.fnmatch(relpath, pat) for pat in self.exclude)
+
+    def split(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """(kept, allowlisted, stale_entries)."""
+        keys = {(e.get("rule"), e.get("path"), e.get("snippet")): e
+                for e in self.allow}
+        used = set()
+        kept, allowed = [], []
+        for f in findings:
+            if f.key() in keys:
+                allowed.append(f)
+                used.add(f.key())
+            else:
+                kept.append(f)
+        stale = [e for k, e in keys.items() if k not in used]
+        return kept, allowed, stale
+
+
+def iter_py_files(root: str, subdirs: Sequence[str]) -> Iterable[Tuple[str, str]]:
+    """Yield (abspath, repo-relative posix path) for every .py under the
+    given subdirs of root, sorted for determinism."""
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                ab = os.path.join(dirpath, name)
+                rel = os.path.relpath(ab, root).replace(os.sep, "/")
+                yield ab, rel
+
+
+def lint_tree(root: str, subdirs: Sequence[str] = ("dexiraft_tpu", "scripts"),
+              baseline: Optional[Baseline] = None,
+              rules: Optional[Set[str]] = None):
+    """Lint the tree; returns (kept, allowed, stale_entries, stats)."""
+    findings: List[Finding] = []
+    n_files = n_excluded = 0
+    for ab, rel in iter_py_files(root, subdirs):
+        if baseline is not None and baseline.excludes(rel):
+            n_excluded += 1
+            continue
+        n_files += 1
+        findings.extend(lint_file(ab, rel, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if baseline is None:
+        return findings, [], [], {"files": n_files, "excluded": n_excluded}
+    kept, allowed, stale = baseline.split(findings)
+    return kept, allowed, stale, {"files": n_files, "excluded": n_excluded}

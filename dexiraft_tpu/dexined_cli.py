@@ -18,6 +18,7 @@ import os
 import os.path as osp
 import sys
 import time
+from functools import partial
 from typing import Optional
 
 import jax
@@ -151,7 +152,10 @@ def train(args) -> None:
     tx = optax.adamw(args.lr, weight_decay=args.wd)
     opt_state = tx.init(params)
 
-    @jax.jit
+    # donate the threaded state: without it the pre-update params/opt
+    # moments stay resident across the call and double the step's HBM
+    # (jaxlint JL006)
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             preds, mut = model.apply(
@@ -199,7 +203,8 @@ def train(args) -> None:
                 params, batch_stats, opt_state, images, labels)
             if b % 5 == 0:
                 print(f"{time.ctime()} Epoch: {epoch} Sample {b}/"
-                      f"{steps_per_epoch} Loss: {float(loss):.4f}")
+                      f"{steps_per_epoch} Loss: "
+                      f"{float(jax.device_get(loss)):.4f}")
 
         state = TrainState(step=jnp.int32((epoch + 1) * steps_per_epoch),
                            params=params, batch_stats=batch_stats,
@@ -208,17 +213,20 @@ def train(args) -> None:
         # introduced BEFORE that batch's update; state_ok (computed on
         # the post-update state inside the step) catches the final
         # batch's own update poisoning the state the save would persist
-        if not args.no_guard and guard.poisoned(float(loss),
-                                                bool(state_ok)):
+        # ONE explicit epoch-end fetch (jaxlint JL007: device_get makes
+        # the sync visible and transfer-guard-clean), reused everywhere
+        loss_h = float(jax.device_get(loss))
+        ok_h = bool(jax.device_get(state_ok))
+        if not args.no_guard and guard.poisoned(loss_h, ok_h):
             rollback_msg = guard.consume_rollback(
-                float(loss), bool(state_ok), f"epoch {epoch}", last_saved,
+                loss_h, ok_h, f"epoch {epoch}", last_saved,
                 ckpt_dir=args.checkpoint)
             prev = ckpt_io.restore_checkpoint(args.checkpoint, state,
                                               step=last_saved)
             params, batch_stats, opt_state = (
                 prev.params, prev.batch_stats, prev.opt_state)
-            print(f"[guard] poisoned epoch {epoch} (loss {float(loss):.4g}, "
-                  f"state_finite={bool(state_ok)}); {rollback_msg}")
+            print(f"[guard] poisoned epoch {epoch} (loss {loss_h:.4g}, "
+                  f"state_finite={ok_h}); {rollback_msg}")
             continue
         ckpt_io.save_checkpoint(args.checkpoint, state)
         last_saved = int(state.step)

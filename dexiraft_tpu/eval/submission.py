@@ -25,6 +25,7 @@ import os
 import os.path as osp
 from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
 import numpy as np
 
 from dexiraft_tpu.data.flow_io import write_flo, write_flow_kitti
@@ -132,10 +133,17 @@ def create_sintel_submission(
             padder = InputPadder(s["image1"].shape)
             im1, im2 = padder.pad(s["image1"][None], s["image2"][None])
             flow_low, flow_up = eval_fn(im1, im2, flow_init=flow_prev)
-            flow = np.asarray(padder.unpad(np.asarray(flow_up)))[0]
+            # explicit fetch (jaxlint JL007): the per-frame sync is the
+            # point of this loop — device_get says so out loud, and the
+            # strict transfer guard (analysis.guards) lets it through
+            flow = np.asarray(padder.unpad(jax.device_get(flow_up)))[0]
 
             if warm_start:
-                flow_prev = np.asarray(forward_interpolate(flow_low[0]))[None]
+                # fetch FIRST, interpolate on host: forward_interpolate
+                # is numpy, and handing it a device array would be an
+                # implicit (strict-guard-tripping) transfer
+                flow_prev = forward_interpolate(
+                    jax.device_get(flow_low)[0])[None]
 
             _write_sintel(output_path, dstype, sequence, frame, flow)
             sequence_prev = sequence
@@ -174,5 +182,5 @@ def create_kitti_submission(
         padder = InputPadder(s["image1"].shape, mode="kitti")
         im1, im2 = padder.pad(s["image1"][None], s["image2"][None])
         _, flow_up = eval_fn(im1, im2)
-        flow = np.asarray(padder.unpad(np.asarray(flow_up)))[0]
+        flow = np.asarray(padder.unpad(jax.device_get(flow_up)))[0]
         write_flow_kitti(osp.join(output_path, frame_id), flow)

@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard each inference batch over this many "
                         "chips (0 = single chip); batch_size must "
                         "divide by it")
+    # runtime guard mode (analysis/guards.py, docs/static_analysis.md)
+    p.add_argument("--strict", action="store_true",
+                   help="run evaluation inside guards.strict_mode: "
+                        "implicit host<->device transfers raise and any "
+                        "recompile beyond the expected one-per-geometry "
+                        "warmup fails the run")
     return p
 
 
@@ -124,21 +130,64 @@ def _make_eval_fn(args, cfg, variables, iters):
         variables = replicate(variables, mesh)
     step = make_eval_step(cfg, iters=iters, mesh=mesh)
     if mesh is None:
+        # explicit H2D put (jaxlint/guards): callers hand numpy frames;
+        # device_put keeps the transfer visible and legal under the
+        # strict transfer guard (the put is async — dispatch overlap is
+        # preserved). Variables go up ONCE here — restored checkpoints
+        # are host numpy, and re-transferring them per call would be an
+        # implicit (guard-tripping) put on every frame.
+        variables = jax.device_put(variables)
+        put = jax.device_put
         return (lambda im1, im2, flow_init=None:
-                step(variables, im1, im2, flow_init=flow_init)), None
+                step(variables, put(im1), put(im2),
+                     flow_init=(None if flow_init is None
+                                else put(flow_init)))), None
     return (lambda im1, im2, flow_init=None:
             step(variables, im1, im2, None, None, flow_init)), mesh
 
 
-def _make_engine(args, eval_fn, mesh, mode, warm_start=False):
+def _make_engine(args, eval_fn, mesh, mode, warm_start=False, watch=None):
     from dexiraft_tpu.serve import InferenceEngine, ServeConfig
 
-    return InferenceEngine(
+    engine = InferenceEngine(
         eval_fn,
         ServeConfig(batch_size=args.batch_size, mode=mode,
                     bucket_multiple=args.bucket_multiple,
-                    inflight=args.inflight, warm_start=warm_start),
+                    inflight=args.inflight, warm_start=warm_start,
+                    strict=args.strict),
         mesh=mesh)
+    if watch is not None:
+        # share the CLI's strict_mode watch: the engine's expected
+        # bucket compiles re-baseline it, so the region's exit check
+        # only fires on genuinely unplanned recompiles
+        engine.watch = watch
+    return engine
+
+
+def _strict_wrap(eval_fn, watch):
+    """Per-geometry compile absorption for the per-image eval loops.
+
+    The first call on a new input-shape signature is an EXPECTED compile
+    (re-baselines the watch); a repeat signature must ride the compiled
+    executable — if it compiled anyway, that is shape/dtype drift and
+    the watch raises.
+    """
+    import numpy as np
+
+    seen = set()
+
+    def wrapped(im1, im2, flow_init=None):
+        sig = (np.shape(im1), np.shape(im2),
+               None if flow_init is None else np.shape(flow_init))
+        out = eval_fn(im1, im2, flow_init=flow_init)
+        if sig in seen:
+            watch.check()
+        else:
+            seen.add(sig)
+            watch.mark_warm()
+        return out
+
+    return wrapped
 
 
 def main(argv=None) -> None:
@@ -151,6 +200,31 @@ def main(argv=None) -> None:
 
     cfg, variables = load_variables(args)
 
+    import contextlib
+
+    region = contextlib.ExitStack()
+    watch = None
+    if args.strict:
+        from dexiraft_tpu.analysis import guards
+
+        # ONE strict region over every eval/submission below: implicit
+        # host<->device transfers raise at the offending call, and the
+        # region's exit check fails the run on any compile the
+        # per-geometry absorption (_strict_wrap / the engine's
+        # mark_warm) did not expect. docs/static_analysis.md.
+        # The data-parallel path keeps the pinned in_shardings' own
+        # transfer semantics (the jitted step ingests host numpy frames
+        # by design — same carve-out as serve_bench), so only the
+        # recompile sentinel is armed there.
+        watch = region.enter_context(guards.strict_mode(
+            label="eval",
+            transfer="allow" if args.data_parallel else "disallow"))
+
+    with region:
+        _run_eval(args, cfg, variables, watch)
+
+
+def _run_eval(args, cfg, variables, watch) -> None:
     if args.dataset:
         from dexiraft_tpu.eval.validate import run_validation
 
@@ -165,7 +239,9 @@ def main(argv=None) -> None:
         engine = None
         if _serving(args):
             mode = "kitti" if args.dataset in ("kitti", "hd1k") else "sintel"
-            engine = _make_engine(args, eval_fn, mesh, mode)
+            engine = _make_engine(args, eval_fn, mesh, mode, watch=watch)
+        elif watch is not None:
+            eval_fn = _strict_wrap(eval_fn, watch)
         run_validation(args.dataset, eval_fn, dataset,
                        batch_size=args.batch_size, engine=engine)
         if engine is not None:
@@ -176,8 +252,10 @@ def main(argv=None) -> None:
 
         eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters or 32)
         engine = (_make_engine(args, eval_fn, mesh, "sintel",
-                               warm_start=args.warm_start)
+                               warm_start=args.warm_start, watch=watch)
                   if _serving(args) else None)
+        if engine is None and watch is not None:
+            eval_fn = _strict_wrap(eval_fn, watch)
         create_sintel_submission(
             eval_fn,
             output_path=args.output or "sintel_submission",
@@ -188,8 +266,10 @@ def main(argv=None) -> None:
         from dexiraft_tpu.eval.submission import create_kitti_submission
 
         eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters or 24)
-        engine = (_make_engine(args, eval_fn, mesh, "kitti")
+        engine = (_make_engine(args, eval_fn, mesh, "kitti", watch=watch)
                   if _serving(args) else None)
+        if engine is None and watch is not None:
+            eval_fn = _strict_wrap(eval_fn, watch)
         create_kitti_submission(
             eval_fn,
             output_path=args.output or "kitti_submission",

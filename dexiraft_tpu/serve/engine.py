@@ -66,6 +66,10 @@ class ServeConfig:
     # streams keep one executable per bucket instead of two (None vs
     # array signatures)
     warm_start: bool = False
+    # strict guard mode (analysis/guards.py): a recompile on an
+    # already-compiled bucket signature RAISES RecompileBudgetExceeded
+    # instead of the default one-line drift warning
+    strict: bool = False
 
     def __post_init__(self):
         if self.batch_size < 1:
@@ -125,6 +129,13 @@ class InferenceEngine:
         self.stats = ServeStats()
         self.compile_s = 0.0  # time inside first-dispatch eval_fn calls
         self._inflight: "collections.deque[_Ticket]" = collections.deque()
+        # recompile drift sentinel (analysis.guards): a fresh bucket is
+        # an EXPECTED compile; a compile on an already-compiled signature
+        # is shape/dtype drift eating throughput — surfaced as a
+        # one-line warning even when the caller never asked for --strict
+        from dexiraft_tpu.analysis.guards import RecompileWatch
+
+        self.watch = RecompileWatch("serve")
 
     # ---- input validation ----------------------------------------------
 
@@ -219,8 +230,16 @@ class InferenceEngine:
             # documents (host pad/stack/put/enqueue time)
             self.compile_s += t2 - t1
             self.stats.dispatch_s += t1 - t0
+            # expected compile: move the drift baseline past it
+            self.watch.mark_warm()
         else:
             self.stats.dispatch_s += t2 - t0
+            # compiled-signature dispatch that still compiled = drift:
+            # strict engines fail the run, default engines warn once
+            if cfg.strict:
+                self.watch.check()
+            else:
+                self.watch.warn_if_drifted()
         self.stats.batches += 1
         self._inflight.append(_Ticket(
             flow_low, flow_up,
@@ -232,10 +251,14 @@ class InferenceEngine:
     # ---- fetch side ----------------------------------------------------
 
     def _fetch_one(self) -> Iterator[Result]:
+        import jax  # deferred: this module stays importable without jax
+
         ticket = self._inflight.popleft()
         t0 = time.perf_counter()
-        low = np.asarray(ticket.flow_low)
-        up = np.asarray(ticket.flow_up)
+        # explicit device->host fetch (jaxlint JL007): this sync IS the
+        # fetch side's job, and device_get passes a strict transfer guard
+        low = jax.device_get(ticket.flow_low)
+        up = jax.device_get(ticket.flow_up)
         now = time.perf_counter()
         self.stats.fetch_s += now - t0
         self.stats.fetches += 1

@@ -33,7 +33,7 @@ class Logger:
         # analog of the divergence guard's loud rollback
         self.pipeline_stats = pipeline_stats
         self.total_steps = 0
-        self.running: Dict[str, float] = {}
+        self.running: Dict[str, list] = {}
         self._tb = None
         self._jsonl = None
         self._t0 = time.perf_counter()
@@ -52,20 +52,31 @@ class Logger:
     def push(self, metrics: Dict[str, float]) -> None:
         """Accumulate one step's metrics; emit every sum_freq steps.
 
-        Device arrays are accumulated as-is (the add dispatches async) and
-        only materialized on the host in _emit — push never blocks on the
-        jitted step, preserving async dispatch between steps.
+        Device scalars are appended as-is — no device math, no host
+        fetch — so push never blocks on the jitted step AND never
+        dispatches an eager op (an eager `prev + v` would compile a tiny
+        jit(add) executable on first use, tripping the strict-mode
+        recompile sentinel; a `0.0 + v` seed would additionally be an
+        implicit host->device transfer). The window is reduced on the
+        host at _emit's one sanctioned sync.
         """
         self.total_steps += 1
         self._steps_since += 1
         for k, v in metrics.items():
-            self.running[k] = self.running.get(k, 0.0) + v
+            self.running.setdefault(k, []).append(v)
         if self.total_steps % self.sum_freq == 0:
             self._emit()
 
     def _emit(self) -> None:
+        import jax  # deferred: keep module importable without jax
+
         n = max(self._steps_since, 1)
-        means = {k: float(v) / n for k, v in self.running.items()}
+        # ONE explicit device->host fetch for the whole window (jaxlint
+        # JL007): this is the loop's sanctioned sync point, and
+        # device_get passes a strict transfer guard
+        host = jax.device_get(self.running)
+        means = {k: float(sum(float(x) for x in vs)) / n
+                 for k, vs in host.items()}
         dt = time.perf_counter() - self._t0
         steps_per_sec = n / dt if dt > 0 else 0.0
         means["steps/sec"] = steps_per_sec

@@ -165,6 +165,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fault injection for tests/scripts/chaos_smoke "
                         "(resilience.chaos.parse_spec), e.g. "
                         "'sigterm@30': real SIGTERM after step 30")
+    # runtime guard mode (analysis/guards.py, docs/static_analysis.md):
+    # the dynamic half of the jaxlint story. Off, drift still surfaces
+    # as a one-line warning on the guard cadence.
+    p.add_argument("--strict", action="store_true",
+                   help="arm guards.strict_mode after warmup: implicit "
+                        "host<->device transfers raise immediately and "
+                        "any post-warmup recompile fails the run "
+                        "(checkpoint/validation windows are exempt — "
+                        "they are sanctioned host I/O)")
     return p
 
 
@@ -228,9 +237,14 @@ def _make_validators(cfg: RAFTConfig, names, variables_fn):
     def run(name: str) -> Dict[str, float]:
         fn = steps[name]
         variables = variables_fn()
+        # explicit H2D put: validators hand numpy frames straight to the
+        # jitted step; device_put keeps the transfer visible and strict-
+        # transfer-guard-clean (analysis.guards)
         return VALIDATORS[name](
-            lambda im1, im2, flow_init=None: fn(variables, im1, im2,
-                                                flow_init=flow_init))
+            lambda im1, im2, flow_init=None: fn(
+                variables, jax.device_put(im1), jax.device_put(im2),
+                flow_init=(None if flow_init is None
+                           else jax.device_put(flow_init))))
 
     return run
 
@@ -292,7 +306,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         pos = load_position(ckpt_dir, last_saved, seed=tc.seed)
         if pos is not None:
             stream_pos = pos
-        print(f"Resumed full state at step {int(state.step)} "
+        print(f"Resumed full state at step "
+              f"{int(jax.device_get(state.step))} "
               f"(data stream: epoch {stream_pos.epoch}, "
               f"batch {stream_pos.offset})")
     elif args.restore_ckpt:
@@ -325,8 +340,24 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
 
     from dexiraft_tpu.train.guard import DivergenceGuard
 
-    total_steps = int(state.step)
+    total_steps = int(jax.device_get(state.step))
     guard = DivergenceGuard(args.guard_threshold, args.max_rollbacks)
+
+    # runtime guard mode (analysis/guards.py): --strict arms the
+    # transfer guard + recompile sentinel AFTER the first step — warmup's
+    # compile (and its constant transfers) is legal; from then on the
+    # steady-state contract holds: zero recompiles, explicit transfers
+    # only. This is guards.strict_mode() unrolled, because the loop
+    # needs mark_warm/check at phase boundaries (warmup, validation)
+    # that a single `with` region cannot express. Non-strict runs keep
+    # the observe-only watch so drift still surfaces as a one-line
+    # warning on the guard cadence.
+    import contextlib
+
+    from dexiraft_tpu.analysis import guards as jaxguards
+
+    guard_stack = contextlib.ExitStack()
+    watch: Optional[jaxguards.RecompileWatch] = None
     # bound to ckpt_dir: --keep_best scores persist in
     # <ckpt_dir>/retention.json, so a preempted-and-resumed run still
     # knows which old step is the best and keeps protecting it
@@ -339,8 +370,11 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         """Checkpoint + stream-position sidecar + retention GC, as one
         operation — every save leaves a resumable, bounded directory."""
         nonlocal last_saved
-        ckpt.save_checkpoint(ckpt_dir, state, step=step)
-        save_position(ckpt_dir, step, stream_pos, seed=tc.seed)
+        # checkpoint I/O is a sanctioned host sync — exempt from the
+        # strict transfer guard
+        with jax.transfer_guard("allow"):
+            ckpt.save_checkpoint(ckpt_dir, state, step=step)
+            save_position(ckpt_dir, step, stream_pos, seed=tc.seed)
         last_saved = step
         retention.apply(ckpt_dir, protect=(last_saved,))
 
@@ -371,6 +405,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     prof_active = True
                 state, metrics = step_fn(state, batch)
                 total_steps += 1
+                if watch is None:
+                    # the first step of this process just compiled —
+                    # arm the steady-state contract from here
+                    watch = jaxguards.RecompileWatch(f"train[{tc.name}]")
+                    watch.mark_warm()
+                    if args.strict:
+                        guard_stack.enter_context(
+                            jax.transfer_guard("disallow"))
                 # note: advanced on CONSUMPTION, never rewound by a
                 # rollback — the stream continues past a divergent
                 # window instead of replaying it. The loader publishes
@@ -406,9 +448,13 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                             last_saved, ckpt_dir=ckpt_dir)
                         # verified restore: should the rollback target
                         # itself turn out damaged, fall back further
-                        # rather than crash mid-recovery
-                        state, last_saved = restore_verified(
-                            ckpt_dir, state, step=last_saved)
+                        # rather than crash mid-recovery. Restore is
+                        # sanctioned host I/O (strict-guard exempt), and
+                        # it may recompile nothing — but the guard must
+                        # not turn recovery into a second failure.
+                        with jax.transfer_guard("allow"):
+                            state, last_saved = restore_verified(
+                                ckpt_dir, state, step=last_saved)
                         # the restored state has no fresh metrics; leaving
                         # the poisoned step's here would make the END-OF-RUN
                         # guard below veto the final save of a GOOD state
@@ -429,6 +475,15 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                                       - (total_steps - last_saved))
                         total_steps = last_saved
                         continue  # never checkpoint on a rollback step
+
+                # recompile sentinel, on the same cadence as the guard:
+                # strict raises, non-strict warns once (satellite: drift
+                # surfaces even when --strict is off)
+                if total_steps % args.guard_every == 0:
+                    if args.strict:
+                        watch.check()
+                    else:
+                        watch.warn_if_drifted()
 
                 if preempt.triggered:
                     # graceful preemption: ONE emergency save at the
@@ -463,18 +518,24 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
 
                 if total_steps % tc.val_freq == 0:
                     save_with_position(total_steps)
-                    for vname in tc.validation:
-                        results = validate(vname)
-                        logger.write_dict(results, step=total_steps)
-                        # retention's quality signal: the first EPE-like
-                        # scalar of the FIRST validation set (lower =
-                        # better) ranks this checkpoint for --keep_best
-                        if vname == tc.validation[0] and results:
-                            epe_keys = [k for k in results if "epe" in k
-                                        or k == vname]
-                            if epe_keys:
-                                retention.note_score(total_steps,
-                                                     results[epe_keys[0]])
+                    # validation is a sanctioned window: its eval steps
+                    # compile once per set (absorbed by mark_warm below)
+                    # and its dataset readers are host-side by design
+                    with jax.transfer_guard("allow"):
+                        for vname in tc.validation:
+                            results = validate(vname)
+                            logger.write_dict(results, step=total_steps)
+                            # retention's quality signal: the first
+                            # EPE-like scalar of the FIRST validation set
+                            # (lower = better) ranks this checkpoint for
+                            # --keep_best
+                            if vname == tc.validation[0] and results:
+                                epe_keys = [k for k in results
+                                            if "epe" in k or k == vname]
+                                if epe_keys:
+                                    retention.note_score(
+                                        total_steps, results[epe_keys[0]])
+                    watch.mark_warm()
                 if total_steps >= tc.num_steps:
                     break
     finally:
@@ -484,6 +545,10 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         # in-flight prefetched device batches have no work left to do
         # while validation and the final save run below
         batches.close()
+        # disarm the transfer guard WITH the loop (also on the error
+        # path — a leaked 'disallow' would poison later jax use in this
+        # process); the final save below is host I/O, not steady state
+        guard_stack.close()
     if prof_active:  # window extended past the last step: finalize
         jax.profiler.stop_trace()
         print(f"[profile] trace (truncated at end of run) -> {prof_dir}")
@@ -508,6 +573,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     print(f"[prefetch] {batches.summary()}")
     if loader.stats.faults:
         print(f"[pipeline] {loader.stats.summary()}")
+    # end-of-run sentinel verdict: strict fails the run on any
+    # unabsorbed post-warmup compile; non-strict gets the (once-only)
+    # drift warning if the cadence check never fired
+    if watch is not None:
+        if args.strict:
+            watch.check()
+        else:
+            watch.warn_if_drifted()
     if preempted:
         print(f"Preempted ({preempt.signal_name}) at step {total_steps} "
               f"-> {ckpt_dir}")

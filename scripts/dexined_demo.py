@@ -20,6 +20,7 @@ import argparse
 import os.path as osp
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
 
@@ -128,7 +129,9 @@ def main():
     tx = optax.adam(args.lr)
     opt_state = tx.init(params)
 
-    @jax.jit
+    # donate the threaded state (jaxlint JL006): the demo's step would
+    # otherwise hold pre- and post-update params/moments simultaneously
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             preds, mut = model.apply(
@@ -154,7 +157,7 @@ def main():
         return jax.nn.sigmoid(preds[-1][..., 0])
 
     def val_f1(params, batch_stats):
-        probs = np.asarray(fused_prob(params, batch_stats, val_im))
+        probs = jax.device_get(fused_prob(params, batch_stats, val_im))
         gt = np.asarray(val_gt[..., 0])
         return float(np.mean([f_measure(probs[i], gt[i])
                               for i in range(probs.shape[0])]))
@@ -182,7 +185,7 @@ def main():
             # throughput.
             # drain the async train stream first (loss fetch = sync
             # point) so pending steps accrue to train time, not eval
-            loss_v = float(loss)
+            loss_v = float(jax.device_get(loss))
             f1 = ""
             train_elapsed = time.perf_counter() - t0 - eval_s
             if i % 50 == 0 or i == args.steps - 1:

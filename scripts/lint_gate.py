@@ -1,0 +1,107 @@
+"""CI lint gate: run jaxlint over dexiraft_tpu/ + scripts/, exit nonzero
+on any unallowlisted finding.
+
+This is the commit-time tripwire for the JAX/TPU footgun class the
+benches can only catch after the fact (silent recompiles, implicit
+host syncs, PRNG key reuse, missing donation — see
+docs/static_analysis.md). Runs pre-pytest in the verify path; pure
+stdlib, no jax import (jaxlint.py is loaded by file path so even
+package __init__ side effects stay out), so it finishes in ~a second
+and works offline.
+
+Usage:
+  python scripts/lint_gate.py                 # gate: exit 1 on findings
+  python scripts/lint_gate.py --emit-allow    # print ready-to-paste
+                                              # baseline.json entries for
+                                              # current findings
+  python scripts/lint_gate.py --list-rules
+  python scripts/lint_gate.py path/to/file.py # lint specific files
+
+Determinism config: dexiraft_tpu/analysis/baseline.json —
+  "exclude": glob list of files the gate skips (archived probe scripts),
+  "allow":   reviewed findings (rule + path + stripped source line +
+             reason). A stale allow entry (matching nothing) fails the
+             gate too: excuses die with the code they excused.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os.path as osp
+import sys
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+LINTER = osp.join(REPO, "dexiraft_tpu", "analysis", "jaxlint.py")
+BASELINE = osp.join(REPO, "dexiraft_tpu", "analysis", "baseline.json")
+
+
+def _load_jaxlint():
+    spec = importlib.util.spec_from_file_location("_jaxlint", LINTER)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules
+    sys.modules["_jaxlint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("lint_gate")
+    ap.add_argument("files", nargs="*",
+                    help="specific repo-relative files (default: the "
+                         "whole dexiraft_tpu/ + scripts/ tree)")
+    ap.add_argument("--baseline", default=BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="raw findings: no excludes, no allowlist")
+    ap.add_argument("--emit-allow", action="store_true",
+                    help="print baseline.json 'allow' entries for every "
+                         "current finding (review before pasting!)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    jl = _load_jaxlint()
+    if args.list_rules:
+        for rule, name in sorted(jl.RULES.items()):
+            print(f"{rule}  {name}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        baseline = jl.Baseline.load(args.baseline)
+
+    if args.files:
+        findings = []
+        for rel in args.files:
+            rel = rel.replace(osp.sep, "/")
+            if baseline is not None and baseline.excludes(rel):
+                continue
+            findings.extend(jl.lint_file(osp.join(REPO, rel), rel))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        if baseline is not None:
+            kept, allowed, _ = baseline.split(findings)
+            stale = []  # partial run can't judge staleness
+        else:
+            kept, allowed, stale = findings, [], []
+        stats = {"files": len(args.files), "excluded": 0}
+    else:
+        kept, allowed, stale, stats = jl.lint_tree(REPO, baseline=baseline)
+
+    if args.emit_allow:
+        print(json.dumps([f.baseline_entry() for f in kept], indent=2))
+        return 0 if not kept else 1
+
+    for f in kept:
+        print(f)
+    for e in stale:
+        print(f"stale baseline entry (matches nothing — remove it): "
+              f"{json.dumps(e)}")
+    ok = not kept and not stale
+    print(f"lint gate: {stats['files']} files, {len(kept)} finding(s), "
+          f"{len(allowed)} allowlisted, {stats['excluded']} excluded"
+          f"{'' if ok else ' — FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

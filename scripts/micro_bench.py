@@ -40,10 +40,11 @@ def timeit(name, fn, *args, reps=3):
         lambda *a: jax.tree_util.tree_reduce(
             lambda acc, x: acc + jnp.sum(x).astype(jnp.float32),
             fn(*a), jnp.float32(0)))
-    float(reduced(*args))  # compile + warmup
+    float(jax.device_get(reduced(*args)))  # compile + warmup
     t0 = time.perf_counter()
     for _ in range(reps):
-        float(reduced(*args))
+        # explicit scalar fetch = the sync (jaxlint JL007)
+        float(jax.device_get(reduced(*args)))
     dt = (time.perf_counter() - t0) / reps
     print(f"{name:>11s}: {dt * 1e3:8.1f} ms   (-rtt {max(dt - _RTT[0], 0) * 1e3:8.1f} ms)")
     return dt
@@ -65,9 +66,9 @@ def main() -> None:
     _RTT[0] = timeit("rtt", lambda x: x, jnp.ones((8, 8)))
 
     h8, w8, c = HEIGHT // 8, WIDTH // 8, 256
-    key = jax.random.PRNGKey(0)
-    f1 = jax.random.normal(key, (1, h8, w8, c), jnp.float32)
-    f2 = jax.random.normal(jax.random.fold_in(key, 1), (1, h8, w8, c))
+    kf1, kf2 = jax.random.split(jax.random.PRNGKey(0))
+    f1 = jax.random.normal(kf1, (1, h8, w8, c), jnp.float32)
+    f2 = jax.random.normal(kf2, (1, h8, w8, c))
 
     # --- volume build (both streams, all levels) ---
     def volume(f1, f2):

@@ -83,6 +83,7 @@ def _measure() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from dexiraft_tpu import config as C
+    from dexiraft_tpu.analysis import guards
     from dexiraft_tpu.config import TrainConfig
     from dexiraft_tpu.profiling import enable_persistent_cache
     from dexiraft_tpu.serve import InferenceEngine, ServeConfig
@@ -108,7 +109,13 @@ def _measure() -> None:
         variables = replicate(variables, mesh)
     step = make_eval_step(cfg, iters=args.iters, mesh=mesh)
     if mesh is None:
-        eval_fn = lambda a, b, fi: step(variables, a, b, flow_init=fi)
+        # explicit H2D puts: the engine hands host-stacked numpy
+        # batches; spelling the transfer keeps the strict region below
+        # (guards.strict_mode) clean without widening its teeth
+        put = jax.device_put
+        eval_fn = lambda a, b, fi: step(
+            variables, put(a), put(b),
+            flow_init=None if fi is None else put(fi))
     else:
         eval_fn = lambda a, b, fi: step(variables, a, b, None, None, fi)
     print(f"platform={jax.devices()[0].platform} variant={args.variant} "
@@ -140,8 +147,10 @@ def _measure() -> None:
                         inflight=args.inflight),
             mesh=mesh)
         # warmup pass compiles every bucket (counted); the timed pass
-        # must ride the in-process executable cache only
-        t0 = time.perf_counter()
+        # must ride the in-process executable cache only. Draining
+        # stream() IS the sync: every yielded Result was device_get-ed
+        # by the engine's fetch side.
+        t0 = time.perf_counter()  # jaxlint: disable=JL004
         for _ in engine.stream(dict(it) for it in pool):
             pass
         warm_s = time.perf_counter() - t0
@@ -151,9 +160,19 @@ def _measure() -> None:
         engine.stats.reset()
         engine.registry.hits.clear()  # report the TIMED stream's hits
         # (the compiled-signature set survives: compiles stays honest)
-        t0 = time.perf_counter()
-        n = sum(1 for _ in engine.stream(dict(it) for it in pool))
-        dt = time.perf_counter() - t0
+        # steady-state contract (analysis/guards): warmup compiled every
+        # bucket, so the timed stream must be compile-FLAT — a retrace
+        # (or, single-chip, an implicit host transfer) here FAILS the
+        # bench instead of silently deflating its number. The mesh path
+        # keeps pinned in_shardings' own transfer semantics, so only the
+        # recompile sentinel is armed there.
+        # draining stream() fetches every Result to host (the sync)
+        with guards.strict_mode(
+                label=f"serve_bench[b={batch_size}]",
+                transfer="disallow" if mesh is None else "allow"):
+            t0 = time.perf_counter()  # jaxlint: disable=JL004
+            n = sum(1 for _ in engine.stream(dict(it) for it in pool))
+            dt = time.perf_counter() - t0
         print(f"[b={batch_size}] timed {dt * 1e3:.1f} ms for {n} pairs; "
               f"{engine.stats.summary()}", file=sys.stderr)
 

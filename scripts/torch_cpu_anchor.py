@@ -180,11 +180,11 @@ def train_anchor(args):
     batch = {"image1": jnp.asarray(im1), "image2": jnp.asarray(im2),
              "flow": jnp.asarray(gt), "valid": jnp.ones((1, h, w))}
     state, metrics = step_fn(state, batch)  # compile + warm
-    float(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))
     t0 = time.perf_counter()
     for _ in range(args.reps):
         state, metrics = step_fn(state, batch)
-        float(metrics["loss"])  # sync
+        float(jax.device_get(metrics["loss"]))  # explicit sync (JL007)
     jax_s = (time.perf_counter() - t0) / args.reps
     print(f"[anchor] flax train step {jax_s * 1e3:.0f} ms", file=sys.stderr)
 

@@ -53,20 +53,21 @@ def stage_pallas() -> None:
     import os
 
     trivial = jax.jit(lambda x: jnp.sum(x))
-    float(trivial(jnp.ones((8, 8))))
+    float(jax.device_get(trivial(jnp.ones((8, 8)))))
 
     def rtt(n=4):
         t0 = time.perf_counter()
         for _ in range(n):
-            float(trivial(jnp.ones((8, 8))))
+            # explicit scalar fetch = the sync (jaxlint JL007)
+            float(jax.device_get(trivial(jnp.ones((8, 8)))))
         return (time.perf_counter() - t0) / n
 
     def timed(fn, reps=10):
-        float(fn(f1, f2, coords))  # compile + warm
+        float(jax.device_get(fn(f1, f2, coords)))  # compile + warm
         floor = rtt()
         t0 = time.perf_counter()
         for _ in range(reps):
-            float(fn(f1, f2, coords))
+            float(jax.device_get(fn(f1, f2, coords)))
         dt = (time.perf_counter() - t0) / reps
         if dt <= floor:
             # an RTT spike during the floor sample would otherwise
@@ -92,7 +93,10 @@ def stage_pallas() -> None:
                 # block-size-dependent, so a timing may only count for a
                 # config whose values were checked on this very chip
                 try:
-                    out_blk = jax.jit(
+                    # fresh jit per sweep config ON PURPOSE: the env vars
+                    # above change the traced kernel, so a hoisted wrapper
+                    # would serve a stale executable
+                    out_blk = jax.jit(  # jaxlint: disable=JL009
                         lambda a, b_, c_: pallas_local_corr_level(
                             a, b_, c_, 4))(f1, f2, coords)
                 except Exception as e:
@@ -113,7 +117,7 @@ def stage_pallas() -> None:
                     print(f"  pallas {variant}/block={blk}: PARITY "
                           f"MISMATCH ({str(e)[:200]})")
                     continue
-                fn = jax.jit(lambda a, b_, c_: jnp.sum(
+                fn = jax.jit(lambda a, b_, c_: jnp.sum(  # jaxlint: disable=JL009
                     pallas_local_corr_level(a, b_, c_, 4)))
                 results[(variant, blk)] = timed(fn)
                 print(f"  pallas {variant}/block={blk}: "

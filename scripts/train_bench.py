@@ -188,7 +188,9 @@ def main():
     # uncached mode compiles here, once)
     t0 = time.perf_counter()
     state, metrics = step_fn(state, first)
-    float(metrics["loss"])  # forced host sync (block_until_ready unreliable)
+    # explicit scalar fetch = the sync (block_until_ready unreliable
+    # through the relay tunnel; jaxlint JL007)
+    float(jax.device_get(metrics["loss"]))
     first_step_s = time.perf_counter() - t0
     if cache_dir is None:
         compile_s = first_step_s  # compile + one step, combined
@@ -198,11 +200,21 @@ def main():
     # steady state: the chips pull already-resident batches; the only
     # host work between dispatches is the async device_put enqueue
     pf.stats.reset()  # exclude warmup/compile from the record
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = step_fn(state, next(pf))
-    float(metrics["loss"])  # one sync at the END: steps overlap transfers
-    dt = (time.perf_counter() - t0) / args.steps
+    # steady-state contract (analysis/guards): the warmup step above
+    # compiled the ONE donated step, so this loop must be compile-flat
+    # and transfer-explicit — a retrace or implicit host transfer FAILS
+    # the bench instead of silently deflating steps/s. The prefetcher's
+    # puts are explicit device_puts (and thread-local anyway); the one
+    # loss fetch below is an explicit device_get — both pass.
+    from dexiraft_tpu.analysis import guards
+
+    with guards.strict_mode(label="train_bench"):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, metrics = step_fn(state, next(pf))
+        # ONE sync at the END: steps overlap transfers (jaxlint JL007)
+        float(jax.device_get(metrics["loss"]))
+        dt = (time.perf_counter() - t0) / args.steps
     print(f"steady-state {dt * 1e3:.1f} ms/step  "
           f"{1.0 / dt:.2f} steps/s  "
           f"{args.batch * args.iters / dt:.1f} pair-iters/s  "

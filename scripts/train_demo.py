@@ -207,8 +207,8 @@ def main():
         return jnp.mean(jnp.linalg.norm(flow_up - batch["flow"], axis=-1))
 
     def full_heldout_epe(state):
-        return float(np.mean([float(val_epe(state.params,
-                                            state.batch_stats, b))
+        return float(np.mean([float(jax.device_get(
+            val_epe(state.params, state.batch_stats, b)))
                               for b in heldout]))
 
     if start_step:
@@ -220,7 +220,8 @@ def main():
         loop_from = start_step + 1
     else:
         t0 = time.perf_counter()
-        probe0 = float(val_epe(state.params, state.batch_stats, val_batch))
+        probe0 = float(jax.device_get(
+            val_epe(state.params, state.batch_stats, val_batch)))
         log(f"# probe compile+eval {time.perf_counter() - t0:.1f}s "
             f"(untrained probe epe {probe0:.3f})")
         t0 = time.perf_counter()
@@ -230,7 +231,7 @@ def main():
             f"{time.perf_counter() - t0:.0f}s)")
         t0 = time.perf_counter()
         state, metrics = step_fn(state, pool[0])
-        float(metrics["loss"])
+        float(jax.device_get(metrics["loss"]))
         log(f"# compile+first step {time.perf_counter() - t0:.1f}s")
         loop_from = 1
 
@@ -245,12 +246,12 @@ def main():
             # drain the async train stream FIRST (the loss fetch is the
             # sync point) so pending train steps accrue to train time,
             # not to the eval window measured next
-            loss_v = float(metrics["loss"])
-            epe_v = float(metrics["epe"])
+            loss_v = float(jax.device_get(metrics["loss"]))
+            epe_v = float(jax.device_get(metrics["epe"]))
             te = time.perf_counter()
             train_elapsed = te - t0 - eval_s  # before this eval's cost
-            probe_epe = float(val_epe(state.params, state.batch_stats,
-                                      val_batch))
+            probe_epe = float(jax.device_get(
+                val_epe(state.params, state.batch_stats, val_batch)))
             eval_s += time.perf_counter() - te
             # rate over steps run in THIS process — on resume, dividing
             # the global index by post-restart elapsed would inflate it

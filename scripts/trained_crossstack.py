@@ -27,6 +27,7 @@ import sys
 sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
 sys.path.insert(0, osp.dirname(osp.abspath(__file__)))
 
+import jax
 import numpy as np
 
 
@@ -105,7 +106,7 @@ def main():
     ours_epe, ref_epe, xmax = [], [], 0.0
     for bi, b in enumerate(heldout):
         _, up = ours_fn(variables, b["image1"], b["image2"])
-        ours = np.asarray(up)
+        ours = jax.device_get(up)
 
         t1 = torch.from_numpy(
             np.asarray(b["image1"]).transpose(0, 3, 1, 2)).contiguous()

@@ -75,7 +75,7 @@ def main():
     # compile + warmup
     t0 = time.perf_counter()
     seed_w, s = frame_step(variables, frames[0], frames[1], seed)
-    float(s)
+    float(jax.device_get(s))
     print(f"compile+first frame {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
 
@@ -84,7 +84,8 @@ def main():
     acc = 0.0
     for i in range(args.frames):
         seed, s = frame_step(variables, frames[i], frames[i + 1], seed)
-    acc = float(s)  # ONE sync at the end: frames chain through `seed`,
+    acc = float(jax.device_get(s))
+    # ONE sync at the end: frames chain through `seed`,
     # so fetching the last checksum bounds the whole pipeline (per-frame
     # fetches would add one tunnel RTT each)
     dt = (time.perf_counter() - t0) / args.frames

@@ -1,0 +1,507 @@
+"""jaxlint + runtime guards coverage (docs/static_analysis.md).
+
+One positive + one negative fixture per jaxlint rule, the suppression /
+baseline / exclude mechanics, the lint-gate CLI contract (exit 0 on the
+shipped tree, nonzero the moment a fixture footgun is introduced), and
+the runtime half: compile_count / RecompileWatch / strict_mode.
+
+Named zzz to sort LAST (tier-1 budget convention — the 870 s cap evicts
+tail tests, and these are cheap: target well under 15 s total; the only
+jax work is a handful of tiny CPU jits).
+"""
+
+from __future__ import annotations
+
+import json
+import os.path as osp
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dexiraft_tpu.analysis import jaxlint
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+GATE = osp.join(REPO, "scripts", "lint_gate.py")
+
+
+def rules_of(src: str, path: str = "dexiraft_tpu/train/fixture.py"):
+    """Set of rule ids jaxlint raises on a dedented fixture snippet."""
+    return {f.rule for f in jaxlint.lint_source(textwrap.dedent(src), path)}
+
+
+# --------------------------------------------------------------------------
+# per-rule fixtures: positive (fires) + negative (sanctioned spelling)
+# --------------------------------------------------------------------------
+
+
+class TestRuleFixtures:
+    def test_jl001_host_sync_in_jit(self):
+        pos = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x) + 1
+        """
+        assert "JL001" in rules_of(pos)
+        # .item() on a tracer
+        pos2 = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """
+        assert "JL001" in rules_of(pos2)
+        # float() on a traced argument
+        pos3 = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """
+        assert "JL001" in rules_of(pos3)
+        neg = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return x + 1
+
+            y = np.asarray(f(np.ones(3)))  # outside jit: JL007's domain
+        """
+        assert "JL001" not in rules_of(neg)
+
+    def test_jl002_key_reuse(self):
+        pos = """
+            import jax
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+        """
+        assert "JL002" in rules_of(pos)
+        neg = """
+            import jax
+            key = jax.random.PRNGKey(0)
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+        """
+        assert "JL002" not in rules_of(neg)
+
+    def test_jl002_key_consumed_in_loop(self):
+        pos = """
+            import jax
+            key = jax.random.PRNGKey(0)
+            for i in range(3):
+                x = jax.random.normal(key, (2,))
+        """
+        assert "JL002" in rules_of(pos)
+        neg = """
+            import jax
+            key = jax.random.PRNGKey(0)
+            for sub in jax.random.split(key, 3):
+                x = jax.random.normal(sub, (2,))
+        """
+        assert "JL002" not in rules_of(neg)
+
+    def test_jl003_tracer_branch(self):
+        pos = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """
+        assert "JL003" in rules_of(pos)
+        # shape/None checks are static at trace time — sanctioned
+        neg = """
+            import jax
+
+            @jax.jit
+            def f(x, flow_init=None):
+                if x.shape[0] > 1 and flow_init is None:
+                    return x
+                return -x
+        """
+        assert "JL003" not in rules_of(neg)
+
+    def test_jl003_static_argnums_exempt(self):
+        neg = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, mode):
+                if mode:
+                    return x
+                return -x
+        """
+        assert "JL003" not in rules_of(neg)
+
+    def test_jl004_untimed_bench_span(self):
+        pos = """
+            import time
+            import jax
+
+            fn = jax.jit(lambda x: x)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = fn(x)
+                dt = time.perf_counter() - t0
+                return dt
+        """
+        path = "scripts/fixture_bench.py"
+        assert "JL004" in rules_of(pos, path)
+        neg = """
+            import time
+            import jax
+
+            fn = jax.jit(lambda x: x)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = jax.block_until_ready(fn(x))
+                dt = time.perf_counter() - t0
+                return dt
+        """
+        assert "JL004" not in rules_of(neg, path)
+        # the rule scopes to scripts/*bench*.py only
+        assert "JL004" not in rules_of(pos, "dexiraft_tpu/train/x.py")
+
+    def test_jl005_f64_literal(self):
+        pos = """
+            import jax
+            import numpy as np
+            x = np.zeros((2,), dtype=np.float64)
+        """
+        assert "JL005" in rules_of(pos)
+        neg = """
+            import jax
+            import numpy as np
+            x = np.zeros((2,), dtype=np.float32)
+        """
+        assert "JL005" not in rules_of(neg)
+        # no jax import -> not our problem (plain numpy code may be f64)
+        no_jax = """
+            import numpy as np
+            x = np.zeros((2,), dtype=np.float64)
+        """
+        assert "JL005" not in rules_of(no_jax)
+
+    def test_jl006_jit_without_donation(self):
+        pos = """
+            import jax
+
+            @jax.jit
+            def step(state, batch):
+                return state
+        """
+        assert "JL006" in rules_of(pos)
+        neg = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, batch):
+                return state
+        """
+        assert "JL006" not in rules_of(neg)
+        # non-state-threading signatures carry no donation obligation
+        neg2 = """
+            import jax
+
+            @jax.jit
+            def fwd(image1, image2):
+                return image1 - image2
+        """
+        assert "JL006" not in rules_of(neg2)
+
+    def test_jl007_implicit_fetch(self):
+        pos = """
+            import jax
+
+            fn = jax.jit(lambda x: x)
+
+            def run(x):
+                loss = fn(x)
+                return float(loss)
+        """
+        assert "JL007" in rules_of(pos)
+        neg = """
+            import jax
+
+            fn = jax.jit(lambda x: x)
+
+            def run(x):
+                loss = fn(x)
+                return float(jax.device_get(loss))
+        """
+        assert "JL007" not in rules_of(neg)
+
+    def test_jl008_unconditional_loop_sync(self):
+        pos = """
+            import jax
+
+            def loop(xs):
+                out = []
+                for x in xs:
+                    out.append(jax.device_get(x))
+                return out
+        """
+        path = "dexiraft_tpu/train/fixture.py"
+        assert "JL008" in rules_of(pos, path)
+        # cadence-gated syncs are the sanctioned shape
+        neg = """
+            import jax
+
+            def loop(xs):
+                for i, x in enumerate(xs):
+                    if i % 10 == 0:
+                        jax.device_get(x)
+        """
+        assert "JL008" not in rules_of(neg, path)
+        # rule scopes to library train/eval/serve paths, not scripts
+        assert "JL008" not in rules_of(pos, "scripts/fixture.py")
+
+    def test_jl009_jit_in_loop(self):
+        pos = """
+            import jax
+            for i in range(3):
+                f = jax.jit(lambda x: x)
+        """
+        assert "JL009" in rules_of(pos)
+        neg = """
+            import jax
+            f = jax.jit(lambda x: x)
+            for i in range(3):
+                y = f(i)
+        """
+        assert "JL009" not in rules_of(neg)
+
+    def test_jl000_syntax_error(self):
+        assert rules_of("def f(:\n") == {"JL000"}
+
+
+# --------------------------------------------------------------------------
+# suppression + baseline mechanics
+# --------------------------------------------------------------------------
+
+
+class TestSuppression:
+    def test_inline_disable_comment(self):
+        src = """
+            import jax
+            for i in range(3):
+                f = jax.jit(lambda x: x)  # jaxlint: disable=JL009
+        """
+        assert "JL009" not in rules_of(src)
+
+    def test_disable_is_rule_specific(self):
+        src = """
+            import jax
+            for i in range(3):
+                f = jax.jit(lambda x: x)  # jaxlint: disable=JL001
+        """
+        assert "JL009" in rules_of(src)
+
+
+class TestBaseline:
+    SRC = textwrap.dedent("""
+        import jax
+        for i in range(3):
+            f = jax.jit(lambda x: x)
+    """)
+
+    def test_allow_matches_on_rule_path_snippet(self):
+        findings = jaxlint.lint_source(self.SRC, "scripts/x.py")
+        assert findings
+        bl = jaxlint.Baseline(allow=[f.baseline_entry() for f in findings])
+        kept, allowed, stale = bl.split(findings)
+        assert not kept and not stale and len(allowed) == len(findings)
+
+    def test_stale_entry_reported(self):
+        bl = jaxlint.Baseline(allow=[{
+            "rule": "JL009", "path": "scripts/x.py",
+            "snippet": "gone = jax.jit(lambda x: x)", "reason": "old"}])
+        kept, allowed, stale = bl.split(
+            jaxlint.lint_source(self.SRC, "scripts/x.py"))
+        assert kept and stale and not allowed
+
+    def test_exclude_glob(self):
+        bl = jaxlint.Baseline(exclude=["scripts/lookup_ab*.py"])
+        assert bl.excludes("scripts/lookup_ab2.py")
+        assert not bl.excludes("scripts/serve_bench.py")
+
+    def test_shipped_baseline_is_valid_json_with_reasons(self):
+        with open(osp.join(REPO, "dexiraft_tpu", "analysis",
+                           "baseline.json")) as f:
+            raw = json.load(f)
+        for entry in raw["allow"]:
+            assert entry["rule"] in jaxlint.RULES
+            assert entry["reason"].strip()
+
+
+# --------------------------------------------------------------------------
+# the gate CLI: zero-findings pin on the shipped tree + teeth
+# --------------------------------------------------------------------------
+
+
+def _gate(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, GATE, *args], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+
+
+class TestLintGate:
+    def test_shipped_tree_is_clean(self):
+        """THE tier-1 pin: zero unallowlisted findings, zero stale
+        allowlist entries, on every commit."""
+        r = _gate()
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 finding(s)" in r.stdout
+
+    def test_gate_trips_on_introduced_footgun(self, tmp_path):
+        bad = tmp_path / "fixture_footgun.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+        """))
+        rel = osp.relpath(str(bad), REPO)
+        r = _gate(rel)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL002" in r.stdout
+
+    def test_list_rules(self):
+        r = _gate("--list-rules")
+        assert r.returncode == 0
+        for rule in jaxlint.RULES:
+            assert rule in r.stdout
+
+
+# --------------------------------------------------------------------------
+# runtime guards: compile_count / RecompileWatch / strict_mode
+# --------------------------------------------------------------------------
+
+
+class TestGuards:
+    def test_compile_count_flat_on_cache_hit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.ones((3,))
+        f(x)
+        c1 = guards.compile_count()
+        f(x)  # same signature: executable-cache hit, no compile event
+        assert guards.compile_count() == c1
+
+    def test_watch_drift_and_once_only_warning(self, capsys):
+        import io
+
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        watch = guards.RecompileWatch("fixture")
+        watch.mark_warm()
+        assert watch.drift == 0
+        jax.jit(lambda x: x * 3)(jnp.ones((7,)))  # an unplanned compile
+        assert watch.drift >= 1
+        buf = io.StringIO()
+        assert watch.warn_if_drifted(file=buf)
+        assert "recompile(s) after warmup" in buf.getvalue()
+        buf2 = io.StringIO()
+        watch.warn_if_drifted(file=buf2)  # once-only
+        assert buf2.getvalue() == ""
+
+    def test_strict_mode_raises_on_post_warmup_compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        x = jnp.ones((9,))  # created OUTSIDE: eager ops transfer scalars
+        f = jax.jit(lambda x: x - 2)
+        with pytest.raises(guards.RecompileBudgetExceeded):
+            with guards.strict_mode(label="fixture"):
+                f(x)  # first call on this signature: compiles
+
+    def test_strict_mode_budget_absorbs_expected_compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        x = jnp.ones((11,))
+        f = jax.jit(lambda x: x * 5)
+        with guards.strict_mode(compile_budget=1, label="fixture"):
+            f(x)  # the one planned compile
+            f(x)  # cache hit
+
+    def test_strict_mode_disallows_implicit_transfer(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        f = jax.jit(lambda x: x + 4)
+        f(jnp.ones((5,), jnp.float32))  # warm outside the region
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            with guards.strict_mode(compile_budget=1, label="fixture"):
+                f(np.ones((5,), np.float32))  # implicit h2d: rejected
+
+    def test_strict_mode_allows_explicit_put_get(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        f = jax.jit(lambda x: x + 4)
+        f(jnp.ones((5,), jnp.float32))
+        with guards.strict_mode(compile_budget=1, label="fixture"):
+            y = f(jax.device_put(np.ones((5,), np.float32)))
+            host = jax.device_get(y)  # explicit d2h: sanctioned
+        assert host.shape == (5,)
+
+    def test_mark_warm_rebaselines_mid_region(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        x = jnp.ones((13,))
+        f = jax.jit(lambda x: x + 7)
+        with guards.strict_mode(label="fixture") as watch:
+            f(x)             # planned: a new geometry
+            watch.mark_warm()  # absorb it
+            f(x)             # cache hit — exit check stays clean
+
+
+class TestEngineStrictKnob:
+    def test_serve_config_strict_flag_and_watch(self):
+        """InferenceEngine carries the drift watch even without --strict
+        (the non-strict warning satellite) and honors strict=True."""
+        from dexiraft_tpu.serve import InferenceEngine, ServeConfig
+
+        cfg = ServeConfig(batch_size=1, strict=True)
+        assert cfg.strict
+        eng = InferenceEngine(lambda a, b, flow_init=None: (a, b),
+                              ServeConfig(batch_size=1))
+        assert hasattr(eng.watch, "warn_if_drifted")
