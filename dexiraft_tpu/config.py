@@ -111,6 +111,19 @@ def raft_v5(**kw) -> RAFTConfig:
     return RAFTConfig(variant="dual", embed_dexined=True, **kw)
 
 
+# experiment-variant name -> constructor: the --variant surface shared by
+# the train/eval/serve CLIs. Lives here (jax-free) so parser construction
+# — including `serve --help` and the --workers pool parent, which never
+# run the model — doesn't pay the jax import.
+VARIANTS = {
+    "v1": raft_v1, "raft": raft_v1,
+    "v2": raft_v2, "early": raft_v2,
+    "v3": raft_v3, "separate": raft_v3,
+    "v4": raft_v4,
+    "v5": raft_v5, "dual": raft_v5,
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """One training stage. Presets mirror train_standard.sh / train_mixed.sh."""

@@ -45,23 +45,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(numerically identical; see docs/perf.md)")
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--output", default=None, help="submission output dir")
-    p.add_argument("--batch_size", type=int, default=1,
-                   help="frame pairs per forward: 1 = the reference "
-                        "per-image loop; >1 streams the dataset through "
-                        "the throughput-mode inference engine "
-                        "(dexiraft_tpu.serve) with identical metrics")
+    # engine knobs via the ONE shared surface (serve.engine
+    # add_engine_args / ServeConfig.from_args) so the batch-eval and
+    # persistent-service (serve_cli) batching paths cannot drift; eval
+    # keeps batch_size=1 / reference pad shapes (the metric-parity
+    # defaults)
+    from dexiraft_tpu.serve.engine import add_engine_args
+
+    add_engine_args(p, batch_size=1, bucket_multiple=None)
     p.add_argument("--serve", action="store_true",
                    help="route through the inference engine even at "
                         "batch_size 1 (async in-flight dispatch, bucket "
                         "accounting)")
-    p.add_argument("--inflight", type=int, default=2,
-                   help="dispatched-unfetched batches the engine holds "
-                        "before blocking on a host fetch")
-    p.add_argument("--bucket_multiple", type=int, default=None,
-                   help="quantize pad shapes up to multiples of this "
-                        "(bounds compiled executables across mixed "
-                        "geometries; default = stride 8, the exact "
-                        "reference pad shapes)")
     p.add_argument("--data_parallel", type=int, default=0,
                    help="shard each inference batch over this many "
                         "chips (0 = single chip); batch_size must "
@@ -151,10 +146,7 @@ def _make_engine(args, eval_fn, mesh, mode, warm_start=False, watch=None):
 
     engine = InferenceEngine(
         eval_fn,
-        ServeConfig(batch_size=args.batch_size, mode=mode,
-                    bucket_multiple=args.bucket_multiple,
-                    inflight=args.inflight, warm_start=warm_start,
-                    strict=args.strict),
+        ServeConfig.from_args(args, mode=mode, warm_start=warm_start),
         mesh=mesh)
     if watch is not None:
         # share the CLI's strict_mode watch: the engine's expected

@@ -24,7 +24,10 @@ import os
 import time
 from typing import Iterator, Optional
 
-import jax
+# jax is imported inside the three functions that touch it: this module
+# sits on the serve package's import path, and the serve CLI's parser /
+# --workers pool parent must stay jax-free (seconds of import on a TPU
+# host for a process that never runs the model)
 
 # default persistent-cache location (train_cli --compile_cache,
 # scripts/train_bench.py); relative to the process CWD like logs/
@@ -41,6 +44,8 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
     — this repo's jitted steps are exactly the artifacts worth keeping.
     Safe to call more than once; returns the directory used.
     """
+    import jax
+
     cache_dir = cache_dir or DEFAULT_CACHE_DIR
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -100,12 +105,20 @@ class ServeStats:
       * peak_inflight — max dispatched-unfetched batches observed
       * pad_frames    — tail filler items (dispatched for shape
                         stability, masked out of results)
+
+    The latency sample window is BOUNDED (maxlen, default 4096 batches):
+    a long-lived server accumulating every batch latency forever would
+    grow without bound between /stats scrapes, and percentiles over the
+    recent window are what an SLO dashboard wants anyway.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, maxlen: int = 4096) -> None:
+        self.maxlen = maxlen
         self.reset()
 
     def reset(self) -> None:
+        import collections
+
         self.batches = 0
         self.frames = 0          # real frame pairs yielded
         self.pad_frames = 0      # partial-batch tail filler (masked out)
@@ -113,7 +126,8 @@ class ServeStats:
         self.fetch_s = 0.0
         self.fetches = 0
         self.peak_inflight = 0
-        self.batch_latency_s: list = []
+        self.batch_latency_s: "collections.deque" = collections.deque(
+            maxlen=self.maxlen)
 
     def latency_ms(self, p: float) -> float:
         import numpy as np
@@ -134,6 +148,8 @@ class ServeStats:
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
     """Capture a device+host profiler trace into log_dir."""
+    import jax
+
     jax.profiler.start_trace(log_dir)
     try:
         yield
@@ -175,4 +191,6 @@ class StepTimer:
 
 def annotate(name: str):
     """Named region for profile traces (shows up in the trace viewer)."""
+    import jax
+
     return jax.profiler.TraceAnnotation(name)

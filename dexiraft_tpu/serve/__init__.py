@@ -1,13 +1,31 @@
-"""Throughput-mode inference: shape buckets, micro-batching, async
-in-flight dispatch, optional data-parallel serving (ISSUE 3 tentpole)."""
+"""Serving subsystem: throughput-mode inference engine (ISSUE 3) plus
+the persistent flow service around it (ISSUE 6) — SLO-aware request
+scheduling, session warm-start affinity, and the stdlib HTTP tier.
+
+Import layering: buckets/engine/scheduler/sessions import no jax at
+module level (unit-testable with a numpy stub eval_fn); server pulls
+them together; serve_cli owns the jax-heavy restore/step construction.
+"""
 
 from dexiraft_tpu.serve.buckets import BucketRegistry, bucket_shape
-from dexiraft_tpu.serve.engine import InferenceEngine, Result, ServeConfig
+from dexiraft_tpu.serve.engine import (InferenceEngine, Result, ServeConfig,
+                                       add_engine_args)
+from dexiraft_tpu.serve.scheduler import (QueueFull, Scheduler,
+                                          SchedulerClosed, SchedulerStats)
+from dexiraft_tpu.serve.server import FlowService
+from dexiraft_tpu.serve.sessions import SessionStore
 
 __all__ = [
+    "FlowService",
     "BucketRegistry",
     "bucket_shape",
     "InferenceEngine",
     "Result",
     "ServeConfig",
+    "add_engine_args",
+    "QueueFull",
+    "Scheduler",
+    "SchedulerClosed",
+    "SchedulerStats",
+    "SessionStore",
 ]

@@ -57,7 +57,23 @@ class BucketRegistry:
     def compiles(self) -> int:
         return len(self._compiled)
 
+    @staticmethod
+    def _signature_name(key) -> str:
+        """Human name for a compiled-signature key. The engine's keys are
+        ((H, W), warm_bool); anything else renders via str()."""
+        try:
+            (h, w), warm = key
+            return f"{h}x{w}" + ("+warm" if warm else "")
+        except (TypeError, ValueError):
+            return str(key)
+
     def stats(self) -> dict:
+        """Self-describing registry blob. `buckets` carries the SHAPES
+        with their hit counts (which geometries are hot), `compiled` the
+        executable signatures actually built (which are compiling) — the
+        /stats endpoint and serve_bench report both, so a deployment can
+        see a cold bucket (compiled, zero recent hits) vs a hot one vs a
+        geometry still paying compiles."""
         return {
             "stride": self.stride,
             "multiple": self.multiple,
@@ -65,4 +81,6 @@ class BucketRegistry:
                         for (h, w), n in sorted(self.hits.items())},
             "bucket_count": len(self.hits),
             "compiles": self.compiles,
+            "compiled": sorted(self._signature_name(k)
+                               for k in self._compiled),
         }

@@ -77,6 +77,49 @@ class ServeConfig:
         if self.inflight < 1:
             raise ValueError(f"inflight must be >= 1, got {self.inflight}")
 
+    @classmethod
+    def from_args(cls, args, *, mode: str = "sintel",
+                  warm_start: bool = False,
+                  strict: Optional[bool] = None) -> "ServeConfig":
+        """Build from an argparse namespace that went through
+        :func:`add_engine_args` — the ONE construction path eval_cli,
+        serve_cli, and serve_bench share, so the batching knobs cannot
+        drift between the batch-eval and persistent-service code paths."""
+        return cls(
+            batch_size=args.batch_size,
+            mode=mode,
+            bucket_multiple=args.bucket_multiple,
+            inflight=args.inflight,
+            warm_start=warm_start,
+            strict=(getattr(args, "strict", False)
+                    if strict is None else strict),
+        )
+
+
+def add_engine_args(p, *, batch_size: int = 1,
+                    bucket_multiple: Optional[int] = None) -> None:
+    """The shared engine-knob argparse surface (see ServeConfig.from_args).
+    Defaults differ by caller — and the help text reflects the CALLER's
+    defaults, not a hardcoded story: eval keeps batch_size=1 / reference
+    pad shapes (the metric-parity configuration); the serving CLI raises
+    both (batching + bounded executables are the point of a service)."""
+    p.add_argument("--batch_size", type=int, default=batch_size,
+                   help="frame pairs per forward: 1 = the reference "
+                        "per-image loop; >1 streams through the "
+                        "throughput-mode inference engine "
+                        "(dexiraft_tpu.serve) with identical metrics "
+                        f"(default: {batch_size})")
+    p.add_argument("--inflight", type=int, default=2,
+                   help="dispatched-unfetched batches the engine holds "
+                        "before blocking on a host fetch (default: 2)")
+    p.add_argument("--bucket_multiple", type=int, default=bucket_multiple,
+                   help="quantize pad shapes up to multiples of this "
+                        "(bounds compiled executables across mixed "
+                        "geometries); default: "
+                        + (f"{bucket_multiple}" if bucket_multiple
+                           else "stride 8, the exact reference pad "
+                                "shapes"))
+
 
 class Result(NamedTuple):
     """One frame pair's inference output.
@@ -138,6 +181,12 @@ class InferenceEngine:
         self.watch = RecompileWatch("serve")
 
     # ---- input validation ----------------------------------------------
+
+    def validate_item(self, item: Dict[str, Any]) -> None:
+        """Public single-item validation (see _validate_item): the HTTP
+        server rejects malformed requests with a 400 at the door instead
+        of poisoning the scheduler's whole batch with a 500."""
+        self._validate_item(0, item)
 
     def _validate_item(self, index: int, item: Dict[str, Any]) -> None:
         """Reject malformed frames at the door with a clear ValueError.
@@ -331,6 +380,23 @@ class InferenceEngine:
         self._dispatch(buckets.pop(), list(enumerate(items)), mode)
         out = sorted(self._fetch_one(), key=lambda r: r.index)
         return out
+
+    def reset_stats(self) -> None:
+        """Zero the accounting for a fresh measurement window while
+        keeping the compiled-executable state.
+
+        A long-lived server scrapes /stats on a cadence; without this the
+        ServeStats counters (and the latency sample list) accumulate for
+        the life of the process and every scrape re-reports history. The
+        compiled-signature set and the watch baseline survive on purpose:
+        resetting them would misreport the next dispatch on a warm bucket
+        as a fresh compile (and re-arm the drift warning the bucket
+        already absorbed). serve_bench's warmup->timed handoff is the
+        same operation.
+        """
+        self.stats.reset()
+        self.registry.hits.clear()
+        self.compile_s = 0.0
 
     def stats_record(self) -> dict:
         """Self-describing stats blob for bench records / logs."""

@@ -1,0 +1,411 @@
+"""SLO-aware request scheduler: the queue between HTTP handlers and the
+engine.
+
+The InferenceEngine batches a stream it can SEE (eval hands it the whole
+dataset); a service only sees requests as they arrive. The scheduler
+turns arrivals into engine batches under a latency contract:
+
+  * per-bucket FIFO queues — only same-bucket requests can share an
+    executable, so the queue is keyed by the same quantized shape the
+    engine compiles for (buckets.bucket_shape; no registry state is
+    touched from handler threads).
+  * dispatch a FULL batch the moment a bucket reaches batch_size — the
+    throughput-optimal case, identical to eval's grouping.
+  * dispatch a PARTIAL batch when the oldest queued request's latency
+    budget says waiting any longer would miss it: each bucket keeps an
+    EWMA of its measured service time (compile time excluded — a fresh
+    bucket's first batch would otherwise poison the estimate by 100x),
+    and the head request's dispatch deadline is
+    ``t_submit + max(0, slo - est_service)``. Before the first
+    measurement the estimate is slo/2 — early traffic errs toward
+    dispatching small batches rather than missing its budget while the
+    scheduler is still learning.
+  * bounded queue — past ``max_queue`` waiting requests, submit raises
+    QueueFull and the server answers 503. Under overload the service
+    sheds load at admission instead of stretching everyone's latency
+    (goodput stays flat instead of collapsing; serve_bench --closed_loop
+    measures exactly this).
+  * drain — ``drain()`` flips every queue to dispatch-immediately and
+    blocks until empty: the SIGTERM path finishes every admitted request
+    before the process exits, and new submits are refused.
+
+Exactly ONE dispatcher thread calls into the engine (it is not
+thread-safe and the device wants one in-order submission stream);
+handler threads only enqueue and wait on their request's event. The
+decision logic is separated from the thread (``poll_once``) so tests
+drive it with a fake clock, deterministically.
+
+No jax import at module level — like the engine, the scheduler stays
+importable (and unit-testable) with a numpy stub eval_fn.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dexiraft_tpu.serve.buckets import bucket_shape
+from dexiraft_tpu.serve.engine import InferenceEngine, Result
+
+# EWMA weight for new service-time samples: heavy enough to track a
+# warming cache, light enough that one slow batch doesn't collapse the
+# hold window
+_EWMA = 0.3
+_PCTL_WINDOW = 4096  # bounded sample windows, same rationale as ServeStats
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: max_queue requests already waiting (503)."""
+
+
+class SchedulerClosed(RuntimeError):
+    """Submit after drain/close began: the service is shutting down."""
+
+
+class SchedulerStats:
+    """Counter block the /stats endpoint and serve_bench serialize.
+
+    dispatch_full / dispatch_slo / dispatch_drain partition every batch
+    by WHY it left the queue: bucket filled, latency budget said go, or
+    shutdown flush. A high slo share at high load means batch_size or
+    slo_ms is mis-tuned (batches never fill); a high full share at low
+    concurrency means the SLO hold is queueing requests it should
+    release.
+    """
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0          # engine raised; error re-raised to callers
+        self.rejected = 0        # QueueFull admissions
+        self.dispatch_full = 0
+        self.dispatch_slo = 0
+        self.dispatch_drain = 0
+        self.queue_peak = 0
+        self.batch_fill = 0      # real (non-pad) requests dispatched
+        self.wait_s: "collections.deque" = collections.deque(
+            maxlen=_PCTL_WINDOW)
+        self.latency_s: "collections.deque" = collections.deque(
+            maxlen=_PCTL_WINDOW)
+
+    @staticmethod
+    def _pctl_ms(samples, p: float) -> float:
+        if not samples:
+            return 0.0
+        return float(np.percentile(samples, p)) * 1e3
+
+    def record(self) -> dict:
+        batches = (self.dispatch_full + self.dispatch_slo
+                   + self.dispatch_drain)
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "rejected": self.rejected,
+            "dispatch_full": self.dispatch_full,
+            "dispatch_slo": self.dispatch_slo,
+            "dispatch_drain": self.dispatch_drain,
+            "queue_peak": self.queue_peak,
+            "mean_batch_fill": (round(self.batch_fill / batches, 2)
+                                if batches else 0.0),
+            "wait_p50_ms": round(self._pctl_ms(self.wait_s, 50), 2),
+            "wait_p99_ms": round(self._pctl_ms(self.wait_s, 99), 2),
+            "latency_p50_ms": round(self._pctl_ms(self.latency_s, 50), 2),
+            "latency_p99_ms": round(self._pctl_ms(self.latency_s, 99), 2),
+        }
+
+
+class _Request:
+    __slots__ = ("item", "bucket", "t_submit", "event", "result", "error")
+
+    def __init__(self, item: Dict[str, Any], bucket: Tuple[int, int],
+                 t_submit: float):
+        self.item = item
+        self.bucket = bucket
+        self.t_submit = t_submit
+        self.event = threading.Event()
+        self.result: Optional[Result] = None
+        self.error: Optional[BaseException] = None
+
+
+class Scheduler:
+    """Request queue + SLO-aware dynamic batching over one engine."""
+
+    def __init__(self, engine: InferenceEngine, *,
+                 slo_ms: float = 200.0,
+                 max_queue: int = 64,
+                 clock: Callable[[], float] = time.monotonic):
+        if slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.engine = engine
+        self.slo_s = slo_ms / 1e3
+        self.max_queue = max_queue
+        self.clock = clock
+        self.stats = SchedulerStats()
+        # called in the DISPATCHER thread after each successful batch,
+        # with (bucket, results) — the one place extra per-bucket device
+        # work (e.g. the server's carry-splat warm compile) can run with
+        # a guarantee that no other dispatch is concurrent
+        self.post_dispatch: Optional[
+            Callable[[Tuple[int, int], List[Result]], None]] = None
+        self._cv = threading.Condition()
+        self._running = False        # dispatcher currently inside _run()
+        self._quiesce_waiters = 0    # run_quiesced() callers pending
+        self._queues: Dict[Tuple[int, int], "collections.deque[_Request]"] \
+            = {}
+        self._pending = 0
+        self._service_s: Dict[Tuple[int, int], float] = {}
+        self._draining = False
+        self._closed = False
+        self._drained = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- submission side (any thread) ----------------------------------
+
+    def submit_async(self, item: Dict[str, Any]) -> _Request:
+        """Admit one request; returns a handle whose ``event`` fires when
+        ``result`` (or ``error``) is set. Raises QueueFull / Scheduler-
+        Closed instead of queueing what the service cannot honor."""
+        cfg = self.engine.config
+        h, w = np.shape(item["image1"])[:2]
+        bucket = bucket_shape(h, w, cfg.stride, cfg.bucket_multiple)
+        with self._cv:
+            if self._closed or self._draining:
+                raise SchedulerClosed("scheduler is draining/closed")
+            if self._pending >= self.max_queue:
+                self.stats.rejected += 1
+                raise QueueFull(
+                    f"{self._pending} requests already queued "
+                    f"(max_queue={self.max_queue})")
+            req = _Request(item, bucket, self.clock())
+            self._queues.setdefault(bucket, collections.deque()).append(req)
+            self._pending += 1
+            self.stats.submitted += 1
+            self.stats.queue_peak = max(self.stats.queue_peak, self._pending)
+            self._cv.notify()
+        return req
+
+    def submit(self, item: Dict[str, Any],
+               timeout: Optional[float] = None) -> Result:
+        """Blocking submit: admit, wait, return the Result (or re-raise
+        the batch's engine error in the caller's thread). On timeout the
+        request is CANCELLED out of its queue — a caller that already
+        answered 504 must not leave the engine computing flow for a dead
+        request (under overload with client timeouts that zombie work
+        would eat exactly the capacity admission control protects)."""
+        req = self.submit_async(item)
+        if not req.event.wait(timeout):
+            with self._cv:
+                q = self._queues.get(req.bucket)
+                if q is not None and req in q:
+                    q.remove(req)
+                    self._pending -= 1
+            # re-check under no lock: the dispatcher may have taken the
+            # request between the failed wait and the cancellation
+            if not req.event.is_set():
+                raise TimeoutError(
+                    f"request not served within {timeout}s (bucket "
+                    f"{req.bucket}; queue depth {self.queue_depth()})")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._pending
+
+    # ---- dispatch decision (dispatcher thread / tests) ------------------
+
+    def _hold_s(self, bucket: Tuple[int, int]) -> float:
+        est = self._service_s.get(bucket, self.slo_s * 0.5)
+        return max(0.0, self.slo_s - est)
+
+    def _select(self, now: float):
+        """Under self._cv. Returns (bucket, 0.0) when a batch should go
+        NOW, (None, wait_s) when the earliest deadline is wait_s away,
+        (None, None) when every queue is empty."""
+        bs = self.engine.config.batch_size
+        best: Optional[Tuple[float, Tuple[int, int]]] = None
+        for bucket, q in self._queues.items():
+            if not q:
+                continue
+            if len(q) >= bs or self._draining or self._closed:
+                return bucket, 0.0
+            deadline = q[0].t_submit + self._hold_s(bucket)
+            if best is None or deadline < best[0]:
+                best = (deadline, bucket)
+        if best is None:
+            return None, None
+        if now >= best[0]:
+            return best[1], 0.0
+        return None, best[0] - now
+
+    def _take(self, bucket: Tuple[int, int]):
+        """Under self._cv: pop up to batch_size requests off a bucket."""
+        bs = self.engine.config.batch_size
+        q = self._queues[bucket]
+        group = [q.popleft() for _ in range(min(len(q), bs))]
+        self._pending -= len(group)
+        return group, len(group) == bs
+
+    def poll_once(self) -> bool:
+        """One dispatch decision + (if due) one engine batch. The unit
+        tests' deterministic entry point; the dispatcher thread is this
+        in a loop with cv waiting in between."""
+        with self._cv:
+            bucket, _wait = self._select(self.clock())
+            if bucket is None:
+                return False
+            group, full = self._take(bucket)
+        self._run(bucket, group, full)
+        return True
+
+    # ---- dispatch execution (dispatcher thread only) --------------------
+
+    def _run(self, bucket: Tuple[int, int], group: List[_Request],
+             full: bool) -> None:
+        st = self.stats
+        if full:
+            st.dispatch_full += 1
+        elif self._draining or self._closed:
+            st.dispatch_drain += 1
+        else:
+            st.dispatch_slo += 1
+        st.batch_fill += len(group)
+        t0 = self.clock()
+        for r in group:
+            st.wait_s.append(t0 - r.t_submit)
+        compile0 = self.engine.compile_s
+        try:
+            results = self.engine.run_batch([r.item for r in group])
+        except Exception as e:
+            st.failed += len(group)
+            for r in group:
+                r.error = e
+                r.event.set()
+            return
+        # service estimate excludes this batch's compile share: the
+        # first batch on a fresh bucket traces+compiles synchronously,
+        # and folding that into the EWMA would pin the hold window at 0
+        # for the rest of the process life
+        dt = (self.clock() - t0
+              - max(0.0, self.engine.compile_s - compile0))
+        prev = self._service_s.get(bucket)
+        self._service_s[bucket] = (dt if prev is None
+                                   else (1 - _EWMA) * prev + _EWMA * dt)
+        if self.post_dispatch is not None:
+            # BEFORE the events fire: a waiter acting on its result
+            # (e.g. the server's carry splat) must find whatever this
+            # hook compiles already compiled
+            try:
+                self.post_dispatch(bucket, results)
+            except Exception as e:
+                print(f"[scheduler] post_dispatch hook failed: "
+                      f"{type(e).__name__}: {e}", flush=True)
+        now = self.clock()
+        for r, res in zip(group, results):
+            st.latency_s.append(now - r.t_submit)
+            r.result = res
+            r.event.set()
+        st.completed += len(group)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                self._running = False
+                self._cv.notify_all()   # wake run_quiesced waiters
+                while self._quiesce_waiters:
+                    # yield to pending quiesced sections: under
+                    # saturation the dispatcher would otherwise re-take
+                    # work while still holding the lock and starve them
+                    self._cv.wait(timeout=0.05)
+                while True:
+                    bucket, wait = self._select(self.clock())
+                    if bucket is not None:
+                        group, full = self._take(bucket)
+                        self._running = True
+                        break
+                    if self._pending == 0:
+                        if self._closed:
+                            self._drained.set()
+                            return
+                        if self._draining:
+                            self._drained.set()
+                    self._cv.wait(timeout=wait)
+            self._run(bucket, group, full)
+
+    def run_quiesced(self, fn: Callable[[], None]) -> None:
+        """Run `fn` while the dispatcher provably is NOT inside the
+        engine: holding the lock keeps it from taking new work, and the
+        _running flag excludes a batch already in flight. The /stats
+        reset path uses this so zeroing engine.compile_s can never race
+        a dispatch's read-modify-write (a mid-batch reset would fold a
+        whole compile span into the bucket's EWMA service estimate)."""
+        with self._cv:
+            self._quiesce_waiters += 1
+            try:
+                while self._running:
+                    self._cv.wait(timeout=0.05)
+                fn()
+            finally:
+                self._quiesce_waiters -= 1
+                self._cv.notify_all()
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="flow-scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, dispatch everything queued (partial batches
+        go immediately), return True when the queue hit empty."""
+        with self._cv:
+            self._draining = True
+            if self._pending == 0 and self._thread is None:
+                self._drained.set()
+            self._cv.notify()
+        return self._drained.wait(timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain, then stop the dispatcher thread."""
+        self.drain(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining or self._closed
+
+    def stats_record(self) -> dict:
+        """SchedulerStats counters + live queue state + the learned
+        per-bucket service estimates (the SLO policy's working memory)."""
+        with self._cv:
+            depth = self._pending
+            ests = {f"{h}x{w}": round(s * 1e3, 2)
+                    for (h, w), s in sorted(self._service_s.items())}
+        return {
+            **self.stats.record(),
+            "queue_depth": depth,
+            "slo_ms": round(self.slo_s * 1e3, 2),
+            "max_queue": self.max_queue,
+            "service_est_ms": ests,
+            "draining": self.draining,
+        }

@@ -1,0 +1,454 @@
+"""Persistent flow service: the HTTP tier over engine + scheduler +
+sessions.
+
+Pure stdlib (`http.server.ThreadingHTTPServer`) — the repo adds no
+dependency to become a service. One process hosts:
+
+  handler threads  -> Scheduler (SLO-aware same-bucket batching)
+                      -> ONE dispatcher thread -> InferenceEngine
+  SessionStore     -> per-stream flow_init warm-start across requests
+
+Endpoints:
+
+  POST /v1/flow     body = .npz with float arrays ``image1``/``image2``
+                    (H, W, 3); optional ``X-Session-Id`` header opts the
+                    request into warm-start carry. Response: .npz with
+                    ``flow_up`` (H, W, 2) float32; ``X-Warm-Start`` and
+                    ``X-Bucket`` headers describe what served it.
+                    400 malformed, 503 queue-full/draining, 504 SLO-
+                    timeout, 500 engine error.
+  GET  /healthz     JSON liveness; 200 while serving, 503 once draining
+                    (load balancers stop routing before the exit).
+  GET  /stats       JSON {service, engine, scheduler, sessions} —
+                    ServeStats/SchedulerStats/SessionStore records.
+                    ``?reset=1`` zeroes the counters after the scrape
+                    (engine.reset_stats + SchedulerStats.reset): each
+                    scrape window reports ITS traffic, not history.
+
+Graceful shutdown (the PR 4 preemption discipline, service-shaped):
+the first SIGTERM/SIGINT stops admissions (503), lets the scheduler
+drain every queued request, joins the handler threads so every response
+is flushed, then exits; a second signal aborts immediately. In-flight
+work is never dropped — the closed-loop bench and the service test pin
+this.
+
+The npz wire format is deliberate: frames are arrays, JSON-of-lists is
+~10x the bytes and the decode dominates small-image latency; npz is the
+one container numpy reads/writes with zero new deps
+(``allow_pickle=False`` — no code execution surface).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import signal
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from dexiraft_tpu.serve.buckets import bucket_shape
+from dexiraft_tpu.serve.engine import InferenceEngine
+from dexiraft_tpu.serve.scheduler import (QueueFull, Scheduler,
+                                          SchedulerClosed)
+from dexiraft_tpu.serve.sessions import SessionStore
+
+# ---- wire format (shared by server, bench client, tests) ----------------
+
+
+def encode_request(image1, image2) -> bytes:
+    """Client side: one frame pair -> the POST /v1/flow body."""
+    buf = io.BytesIO()
+    np.savez(buf, image1=np.asarray(image1), image2=np.asarray(image2))
+    return buf.getvalue()
+
+
+def decode_request(body: bytes) -> Dict[str, Any]:
+    """Server side: POST body -> engine item dict. ValueError on any
+    malformed payload (the handler's 400 path)."""
+    try:
+        z = np.load(io.BytesIO(body), allow_pickle=False)
+        arrays = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ValueError(f"body is not a readable .npz archive: {e}")
+    for key in ("image1", "image2"):
+        if key not in arrays:
+            raise ValueError(f"npz body missing required array {key!r} "
+                             f"(got {sorted(arrays)})")
+    return {"image1": arrays["image1"], "image2": arrays["image2"]}
+
+
+def encode_response(flow_up: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, flow_up=np.asarray(flow_up, np.float32))
+    return buf.getvalue()
+
+
+def decode_response(body: bytes) -> np.ndarray:
+    """Client side: response body -> (H, W, 2) float32 flow."""
+    z = np.load(io.BytesIO(body), allow_pickle=False)
+    return z["flow_up"]
+
+
+# ---- HTTP plumbing ------------------------------------------------------
+
+
+class _FlowHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that (a) carries the FlowService reference,
+    (b) JOINS handler threads on close — the drain path's guarantee that
+    every admitted response is flushed before exit — and (c) optionally
+    binds with SO_REUSEPORT so ``--workers N`` processes share one port
+    (the kernel load-balances accepts across workers)."""
+
+    daemon_threads = False      # joined at server_close(), not abandoned
+    block_on_close = True
+
+    def __init__(self, addr, handler, service: "FlowService",
+                 reuse_port: bool = False):
+        self.service = service
+        self._reuse_port = reuse_port
+        super().__init__(addr, handler)
+
+    def server_bind(self):
+        if self._reuse_port:
+            if not hasattr(socket, "SO_REUSEPORT"):
+                raise OSError("SO_REUSEPORT unavailable on this platform "
+                              "— multi-worker mode needs it")
+            self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dexiraft-serve/1.0"
+    # keep-alive: closed-loop clients reuse one connection per thread
+    protocol_version = "HTTP/1.1"
+    # an IDLE keep-alive connection must not pin its handler thread
+    # forever: drain joins handler threads (block_on_close), so a
+    # client that holds a connection open without sending would
+    # otherwise stall shutdown until it went away
+    timeout = 30.0
+
+    # ---- helpers -------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet: /stats carries the signal
+        pass
+
+    def _send(self, status: int, body: bytes, content_type: str,
+              headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: dict,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        self._send(status, json.dumps(payload).encode(),
+                   "application/json", headers)
+
+    def _send_error_json(self, status: int, message: str,
+                         retry: bool = False) -> None:
+        self._send_json(status, {"error": message},
+                        {"Retry-After": "1"} if retry else None)
+
+    # ---- GET: health + stats -------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        svc = self.server.service
+        url = urlparse(self.path)
+        if url.path == "/healthz":
+            if svc.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(200, {
+                    "status": "ok",
+                    "uptime_s": round(svc.uptime_s(), 3),
+                    "queue_depth": svc.scheduler.queue_depth(),
+                })
+        elif url.path == "/stats":
+            reset = parse_qs(url.query).get("reset", ["0"])[0] == "1"
+            payload = (svc.snapshot_and_reset() if reset
+                       else svc.stats_record())
+            self._send_json(200, payload)
+        else:
+            self._send_error_json(404, f"no such endpoint {url.path!r}")
+
+    # ---- POST: inference -----------------------------------------------
+
+    def _read_body(self) -> Optional[bytes]:
+        """Read the request body on EVERY path (including the ones that
+        answer 4xx): an unread body on a keep-alive connection would be
+        parsed as the next request line, desyncing every later request
+        on that connection. None (and close_connection) on a body we
+        cannot frame (chunked, bad Content-Length)."""
+        te = self.headers.get("Transfer-Encoding", "")
+        if te and te.lower() != "identity":
+            self.close_connection = True
+            return None
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length < 0:
+                raise ValueError(length)
+        except ValueError:
+            self.close_connection = True
+            return None
+        return self.rfile.read(length) if length > 0 else b""
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        svc = self.server.service
+        body = self._read_body()
+        if body is None:
+            self._send_error_json(
+                400, "unsupported Transfer-Encoding or bad Content-Length")
+            return
+        if urlparse(self.path).path != "/v1/flow":
+            self._send_error_json(404, f"no such endpoint {self.path!r}")
+            return
+        try:
+            item = decode_request(body)
+            # reject malformed input at the door (400) instead of
+            # poisoning a whole scheduler batch deep in the engine (500)
+            svc.engine.validate_item(item)
+        except ValueError as e:
+            self._send_error_json(400, str(e))
+            return
+
+        cfg = svc.engine.config
+        h, w = item["image1"].shape[:2]
+        bucket = bucket_shape(h, w, cfg.stride, cfg.bucket_multiple)
+        session_id = self.headers.get("X-Session-Id")
+        warm = False
+        if session_id and svc.sessions is not None:
+            init = svc.sessions.get(session_id, bucket)
+            if init is not None:
+                item["flow_init"] = init
+                warm = True
+
+        try:
+            result = svc.scheduler.submit(item, timeout=svc.request_timeout_s)
+        except QueueFull as e:
+            self._send_error_json(503, f"overloaded: {e}", retry=True)
+            return
+        except SchedulerClosed:
+            self._send_error_json(503, "draining: service is shutting down")
+            return
+        except TimeoutError as e:
+            self._send_error_json(504, str(e))
+            return
+        except Exception as e:  # engine error, re-raised by submit()
+            self._send_error_json(
+                500, f"inference failed: {type(e).__name__}: {e}")
+            return
+
+        if session_id and svc.sessions is not None:
+            # frame j's carry seeds frame j+1 of the same stream;
+            # carry_fn is the splat hook (serve_cli wires the on-device
+            # forward_interpolate; identity — raw flow_low — otherwise).
+            # Its per-bucket jit compile already happened in the
+            # dispatcher thread (FlowService._post_dispatch), so this
+            # call rides a cached executable — handler threads never
+            # compile, which is what keeps --strict serving race-free.
+            svc.sessions.put(session_id, bucket,
+                             svc.carry_fn(result.flow_low))
+        self._send(200, encode_response(result.flow_up),
+                   "application/x-npz",
+                   {"X-Warm-Start": "1" if warm else "0",
+                    "X-Bucket": f"{bucket[0]}x{bucket[1]}"})
+
+
+# ---- the service object -------------------------------------------------
+
+
+class FlowService:
+    """Engine + scheduler + sessions behind one persistent HTTP endpoint.
+
+    Lifecycle: ``start()`` launches the dispatcher and the HTTP thread;
+    ``drain_and_stop()`` (or the installed SIGTERM handler) refuses new
+    work, finishes everything admitted, flushes responses, and sets
+    ``stopped``. ``port=0`` binds an ephemeral port (tests/bench);
+    ``reuse_port=True`` lets N worker processes share one port.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        slo_ms: float = 200.0,
+        max_queue: int = 64,
+        session_ttl_s: float = 60.0,
+        max_sessions: int = 1024,
+        carry_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        request_timeout_s: float = 60.0,
+        reuse_port: bool = False,
+        clock=None,
+    ):
+        if clock is None:
+            import time
+
+            clock = time.monotonic
+        self.engine = engine
+        self.clock = clock
+        self.scheduler = Scheduler(engine, slo_ms=slo_ms,
+                                   max_queue=max_queue, clock=clock)
+        # session_ttl_s <= 0 = stateless mode (multi-worker default:
+        # kernel accept-balancing breaks per-worker affinity anyway)
+        self.sessions = (SessionStore(session_ttl_s, max_sessions,
+                                      clock=clock)
+                         if session_ttl_s > 0 else None)
+        self.carry_fn = carry_fn if carry_fn is not None else np.asarray
+        self._carry_warm: set = set()   # dispatcher-thread only
+        self.scheduler.post_dispatch = self._post_dispatch
+        self.request_timeout_s = request_timeout_s
+        self._httpd = _FlowHTTPServer((host, port), _Handler, service=self,
+                                      reuse_port=reuse_port)
+        self._http_thread: Optional[threading.Thread] = None
+        self._t0 = clock()
+        self._signal_latched = False
+        self._stop_lock = threading.Lock()
+        self.stopped = threading.Event()
+
+    # ---- introspection -------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self._httpd.server_address[:2]
+        return host, port
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
+    def uptime_s(self) -> float:
+        return self.clock() - self._t0
+
+    def stats_record(self) -> dict:
+        return {
+            "service": {
+                "uptime_s": round(self.uptime_s(), 3),
+                "draining": self.draining,
+                "slo_ms": round(self.scheduler.slo_s * 1e3, 2),
+                "sessions_enabled": self.sessions is not None,
+            },
+            "engine": self.engine.stats_record(),
+            "scheduler": self.scheduler.stats_record(),
+            "sessions": (self.sessions.stats_record()
+                         if self.sessions is not None else None),
+        }
+
+    def _post_dispatch(self, bucket, results) -> None:
+        """Dispatcher-thread hook (scheduler.post_dispatch): compile the
+        carry splat for a freshly served bucket while NO other dispatch
+        can be concurrent, and re-baseline the engine's drift watch past
+        that expected compile. Doing this from handler threads instead
+        would race the dispatcher's --strict check: the splat's backend
+        compile lands in the global counter before any handler-side
+        mark_warm could, and an unrelated batch would raise."""
+        if (self.sessions is None or not results
+                or bucket in self._carry_warm):
+            return
+        self._carry_warm.add(bucket)
+        self.carry_fn(results[0].flow_low)
+        self.engine.watch.mark_warm()
+
+    def _zero_stats(self) -> None:
+        # quiesced-context only (dispatcher provably outside the engine):
+        # zeroing engine.compile_s mid-batch would race the dispatch's
+        # accumulation and fold a compile span into the bucket's EWMA
+        # service estimate
+        self.engine.reset_stats()
+        self.scheduler.stats.reset()
+        if self.sessions is not None:
+            self.sessions.reset_counters()
+
+    def reset_stats(self) -> None:
+        """One measurement-window handoff across every layer: engine
+        counters+latency window, scheduler counters, session flow
+        counters. Compiled executables, learned service-time estimates,
+        and live session carries all survive — they are state, not
+        statistics."""
+        self.scheduler.run_quiesced(self._zero_stats)
+
+    def snapshot_and_reset(self) -> dict:
+        """The /stats?reset=1 path: capture the window's record and zero
+        the counters as ONE quiesced operation. Snapshotting first and
+        resetting after the response went out would lose every request
+        completing in the gap — zeroed without ever being reported in
+        either window."""
+        record: dict = {}
+
+        def _snapshot_reset():
+            record.update(self.stats_record())
+            self._zero_stats()
+
+        self.scheduler.run_quiesced(_snapshot_reset)
+        return record
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FlowService":
+        self.scheduler.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="flow-http", daemon=True)
+        self._http_thread.start()
+        return self
+
+    def drain_and_stop(self, timeout: Optional[float] = 30.0) -> bool:
+        """Refuse new work, finish everything admitted, flush responses,
+        stop. Returns True when the queue drained inside `timeout`.
+        Idempotent — the signal path and an explicit caller can race;
+        the loser of the race just waits for `stopped`."""
+        if not self._stop_lock.acquire(blocking=False):
+            self.stopped.wait(timeout)
+            return not self.scheduler.queue_depth()
+        try:
+            drained = self.scheduler.drain(timeout)
+            # handler threads blocked in submit() have their results;
+            # closing the listener now joins them (block_on_close) so
+            # every response hits the wire before we report stopped
+            if self._http_thread is not None:
+                self._httpd.shutdown()
+            self._httpd.server_close()
+            self.scheduler.close()
+            self.stopped.set()
+            return drained
+        finally:
+            self._stop_lock.release()
+
+    # ---- signals (PR 4 preemption discipline) --------------------------
+
+    def install_signal_handlers(self) -> bool:
+        """First SIGTERM/SIGINT -> background graceful drain; second ->
+        immediate KeyboardInterrupt (a wedged drain must not trap the
+        operator). Returns False off the main thread (signals can only
+        install there — library embedders keep their own handling)."""
+
+        def _handle(signum, frame):
+            if self._signal_latched:
+                raise KeyboardInterrupt(
+                    f"second signal {signum} during drain")
+            self._signal_latched = True
+            print(f"[serve] received signal {signum}; draining "
+                  f"{self.scheduler.queue_depth()} queued request(s), "
+                  f"refusing new work (signal again to abort)", flush=True)
+            threading.Thread(target=self.drain_and_stop,
+                             name="flow-drain", daemon=True).start()
+
+        try:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(s, _handle)
+        except ValueError:
+            return False
+        return True

@@ -1,0 +1,157 @@
+"""Session affinity: per-stream flow_init carry with TTL eviction.
+
+RAFT's iterative design makes consecutive frames of one video stream a
+measured win (scripts/warmstart_bench.py): the previous frame's low-res
+flow seeds the next frame's refinement, so the model starts near the
+answer instead of from zeros. A stateless request API throws that away.
+This store keeps the carry server-side, keyed by a client-chosen stream
+id (the ``X-Session-Id`` header), so a camera/video client gets
+warm-start across plain independent HTTP requests.
+
+Semantics:
+
+  * the carry is BUCKET-SCOPED — flow_init lives at the padded bucket's
+    1/8 resolution (the engine's per-item carry contract, see
+    engine.Result.flow_low). A session whose frames change geometry into
+    a different bucket silently restarts cold (counted, not an error):
+    re-gridding across buckets would hand the model a misaligned seed.
+  * TTL eviction — a stream that stops talking for ``ttl_s`` is dropped;
+    the next request with that id starts cold. Expiry is enforced lazily
+    on every get/put (no reaper thread to leak) plus a full sweep on
+    ``stats_record()`` so /stats never reports ghosts.
+  * LRU bound — at most ``max_sessions`` live streams; admitting one
+    more evicts the least-recently-used (a public endpoint must bound
+    memory against id churn, deliberate or buggy).
+  * thread-safe — handler threads get/put concurrently; one lock, no
+    I/O under it.
+
+A session holds ONE most-recent carry, not history: flow_init for frame
+j+1 is exactly frame j's (splatted) flow_low, nothing older matters.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class _Entry:
+    __slots__ = ("bucket", "carry", "t_touch")
+
+    def __init__(self, bucket: Tuple[int, int], carry: np.ndarray,
+                 t_touch: float):
+        self.bucket = bucket
+        self.carry = carry
+        self.t_touch = t_touch
+
+
+class SessionStore:
+    """TTL+LRU map: stream id -> (bucket, latest flow carry)."""
+
+    def __init__(self, ttl_s: float = 60.0, max_sessions: int = 1024,
+                 clock=None):
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        if max_sessions < 1:
+            raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
+        if clock is None:
+            import time
+
+            clock = time.monotonic
+        self.ttl_s = ttl_s
+        self.max_sessions = max_sessions
+        self.clock = clock
+        self._lock = threading.Lock()
+        # insertion order == recency order (move_to_end on touch)
+        self._entries: "collections.OrderedDict[str, _Entry]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0            # unknown id (fresh stream)
+        self.expired = 0           # TTL evictions
+        self.lru_evicted = 0       # max_sessions evictions
+        self.bucket_resets = 0     # geometry moved buckets -> cold restart
+
+    # ---- internal (lock held) ------------------------------------------
+
+    def _sweep(self, now: float) -> None:
+        """Drop every TTL-expired entry (oldest-touched first)."""
+        dead = [sid for sid, e in self._entries.items()
+                if now - e.t_touch > self.ttl_s]
+        for sid in dead:
+            del self._entries[sid]
+        self.expired += len(dead)
+
+    # ---- handler-thread API --------------------------------------------
+
+    def get(self, session_id: str,
+            bucket: Tuple[int, int]) -> Optional[np.ndarray]:
+        """The stream's carry for this bucket, or None (cold start:
+        unknown id, TTL-expired, or the stream changed buckets)."""
+        now = self.clock()
+        with self._lock:
+            e = self._entries.get(session_id)
+            if e is None:
+                self.misses += 1
+                return None
+            if now - e.t_touch > self.ttl_s:
+                del self._entries[session_id]
+                self.expired += 1
+                return None
+            if e.bucket != bucket:
+                # misaligned seed is worse than a cold start — restart
+                del self._entries[session_id]
+                self.bucket_resets += 1
+                return None
+            e.t_touch = now
+            self._entries.move_to_end(session_id)
+            self.hits += 1
+            return e.carry
+
+    def put(self, session_id: str, bucket: Tuple[int, int],
+            carry: Any) -> None:
+        """Record the stream's newest carry (frame j's splatted flow_low,
+        already host numpy — the engine fetches before yielding)."""
+        carry = np.asarray(carry)
+        now = self.clock()
+        with self._lock:
+            self._sweep(now)
+            e = self._entries.get(session_id)
+            if e is None:
+                while len(self._entries) >= self.max_sessions:
+                    self._entries.popitem(last=False)
+                    self.lru_evicted += 1
+                self._entries[session_id] = _Entry(bucket, carry, now)
+            else:
+                e.bucket = bucket
+                e.carry = carry
+                e.t_touch = now
+                self._entries.move_to_end(session_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def reset_counters(self) -> None:
+        """Zero the flow counters (the /stats?reset=1 scrape window
+        handoff); live sessions — the actual carry state — survive."""
+        with self._lock:
+            self.hits = self.misses = self.expired = 0
+            self.lru_evicted = self.bucket_resets = 0
+
+    def stats_record(self) -> dict:
+        """Self-describing blob for the /stats endpoint."""
+        with self._lock:
+            self._sweep(self.clock())
+            return {
+                "active": len(self._entries),
+                "ttl_s": self.ttl_s,
+                "max_sessions": self.max_sessions,
+                "hits": self.hits,
+                "misses": self.misses,
+                "expired": self.expired,
+                "lru_evicted": self.lru_evicted,
+                "bucket_resets": self.bucket_resets,
+            }

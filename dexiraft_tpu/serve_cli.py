@@ -1,0 +1,281 @@
+"""Persistent flow-service CLI: a restored checkpoint behind HTTP.
+
+  python -m dexiraft_tpu serve --model checkpoints/raft-things \
+      --variant v5 --port 8000 --batch_size 4 --bucket_multiple 64
+
+One process = one worker: restore (verified, PR 4 fallback path) ->
+jitted eval step -> InferenceEngine -> SLO Scheduler -> ThreadingHTTP
+endpoint (serve/server.py). ``--workers N`` scales out: N stateless
+worker processes bind ONE port via SO_REUSEPORT (the kernel balances
+accepts) and share the persistent XLA compile cache, so workers 2..N
+skip the multi-minute compile the first worker paid — relaunch-speed
+scale-out, the PR 2 cache's serving payoff. Session warm-start is a
+single-worker (or sticky-LB) feature: kernel accept-balancing has no
+affinity, so ``--workers > 1`` forces ``--session_ttl_s 0`` (stateless
+mode) unless an external sticky router fronts the pool
+(docs/serving.md).
+
+SIGTERM drains: admitted requests finish and flush before exit
+(PR 4's preemption discipline, service-shaped); a second signal aborts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from dexiraft_tpu.config import VARIANTS
+from dexiraft_tpu.serve.engine import ServeConfig, add_engine_args
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dexiraft-serve")
+    p.add_argument("--model", required=True, help="orbax checkpoint dir "
+                   "(restored via the verified-restore fallback path)")
+    p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--corr_impl", default="allpairs",
+                   choices=["allpairs", "local", "pallas"])
+    p.add_argument("--scan_unroll", type=int, default=1)
+    p.add_argument("--dexined_upconv", default="subpixel",
+                   choices=["transpose", "subpixel"])
+    p.add_argument("--iters", type=int, default=24,
+                   help="refinement iterations per request")
+    p.add_argument("--mode", default="sintel", choices=["sintel", "kitti"],
+                   help="pad placement for bucket padding")
+    # engine knobs — the ONE shared surface with eval_cli/serve_bench
+    # (ServeConfig.from_args); serving defaults raise batch + bucket
+    # granule because bounded executables are the point of a service
+    add_engine_args(p, batch_size=4, bucket_multiple=64)
+    p.add_argument("--data_parallel", type=int, default=0,
+                   help="shard each inference batch over this many chips "
+                        "(0 = single chip); batch_size must divide by it")
+    # service knobs
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--slo_ms", type=float, default=200.0,
+                   help="per-request latency budget: a partial batch "
+                        "dispatches when the oldest queued request's "
+                        "budget (minus the bucket's learned service "
+                        "time) runs out")
+    p.add_argument("--max_queue", type=int, default=64,
+                   help="queued-request admission bound; past it the "
+                        "service sheds load with 503 instead of "
+                        "stretching everyone's latency")
+    p.add_argument("--session_ttl_s", type=float, default=60.0,
+                   help="session warm-start TTL; 0 disables sessions "
+                        "(stateless mode, forced when --workers > 1)")
+    p.add_argument("--request_timeout_s", type=float, default=60.0,
+                   help="per-request server-side wait bound (504 past it)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="worker processes sharing one SO_REUSEPORT port "
+                        "and one persistent compile cache")
+    p.add_argument("--warmup", default=None,
+                   help="comma-separated HxW geometries to pre-compile "
+                        "before accepting traffic (e.g. 440x1024,368x768)")
+    p.add_argument("--compile_cache_dir", default=None,
+                   help="persistent XLA cache dir (default: the repo "
+                        "cache; workers share it for fast scale-out)")
+    p.add_argument("--no_compile_cache", action="store_true")
+    p.add_argument("--strict", action="store_true",
+                   help="PR 5 drift watch with teeth: a recompile on an "
+                        "already-compiled bucket signature raises "
+                        "instead of the one-line warning")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (local shakeout)")
+    p.add_argument("--reuse_port", action="store_true",
+                   help=argparse.SUPPRESS)  # set by the --workers parent
+    return p
+
+
+# ---- multi-worker pool --------------------------------------------------
+
+
+def _run_pool(args, argv) -> None:
+    """Spawn N single-worker children on one SO_REUSEPORT port; forward
+    SIGTERM/SIGINT so every child drains; exit with the worst child rc.
+    Children are STATELESS (sessions off): kernel accept-balancing has
+    no affinity, so carry state would be wrong half the time."""
+    if args.port == 0:
+        raise SystemExit("serve: --workers > 1 needs an explicit --port "
+                         "(ephemeral port 0 would scatter the workers)")
+    # appended flags override the parent's own --workers/--session_ttl_s
+    # (argparse: the last occurrence of a store option wins)
+    child_argv = list(argv) + ["--workers", "1", "--reuse_port",
+                               "--session_ttl_s", "0"]
+    children = []
+    for i in range(args.workers):
+        env = dict(os.environ, DEXIRAFT_SERVE_WORKER=str(i))
+        # own session: a foreground ^C delivers SIGINT to the whole
+        # terminal process group, and _forward would deliver it AGAIN —
+        # two signals is the children's abort gesture, not a drain.
+        # Detached, every signal a child sees comes through _forward,
+        # exactly once.
+        children.append(subprocess.Popen(
+            [sys.executable, "-m", "dexiraft_tpu", "serve"] + child_argv,
+            env=env, start_new_session=True))
+    print(f"[serve] pool: {args.workers} workers on "
+          f"{args.host}:{args.port} (SO_REUSEPORT), shared compile cache, "
+          f"stateless sessions", flush=True)
+
+    def _forward(signum, frame):
+        for c in children:
+            try:
+                c.send_signal(signum)
+            except OSError:
+                pass
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, _forward)
+    rc = 0
+    for c in children:
+        try:
+            rc = max(rc, abs(c.wait()))
+        except KeyboardInterrupt:
+            _forward(signal.SIGINT, None)
+            rc = max(rc, abs(c.wait()))
+    raise SystemExit(rc)
+
+
+# ---- single worker ------------------------------------------------------
+
+
+def _load(args):
+    """Verified restore (PR 4): the newest checkpoint step that passes
+    integrity checks serves; truncated/poisoned steps are skipped (and
+    deleted) loudly instead of crashing the worker at boot."""
+    import jax
+
+    from dexiraft_tpu.config import TrainConfig
+    from dexiraft_tpu.resilience import restore_verified
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train.state import create_state
+
+    try:
+        ckpt.require_checkpoints(args.model)
+    except FileNotFoundError as e:
+        raise SystemExit(f"serve: {e}")
+    cfg = VARIANTS[args.variant](small=args.small,
+                                 mixed_precision=args.mixed_precision,
+                                 corr_impl=args.corr_impl,
+                                 dexined_upconv=args.dexined_upconv,
+                                 scan_unroll=args.scan_unroll)
+    template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
+    state, step = restore_verified(args.model, template)
+    # the server never saves: release orbax's per-manager machinery now
+    # instead of carrying it for the life of the process
+    ckpt.close_managers()
+    print(f"[serve] restored verified checkpoint step {step} from "
+          f"{args.model}", flush=True)
+    return cfg, state.variables
+
+
+def _make_carry_fn():
+    """Session carry = the submission loop's splat: the previous frame's
+    low-res flow forward-interpolated to the next frame's grid, fetched
+    once (explicitly) to host numpy for the store."""
+    import jax
+
+    from dexiraft_tpu.eval.interpolate import forward_interpolate
+
+    return lambda flow_low: jax.device_get(forward_interpolate(flow_low))
+
+
+def _warmup(engine, geometries, carry_fn=None) -> None:
+    """Pre-compile the named buckets before the listener opens: the
+    first real request on a cold bucket would otherwise eat the compile
+    inside its latency budget. With sessions on, the engine always
+    materializes flow_init (warm_start=True), so one signature per
+    bucket covers cold AND warm traffic — and the carry splat
+    (forward_interpolate, jitted per bucket shape) compiles here too,
+    so --strict serving is compile-flat from the first request."""
+    import numpy as np
+
+    for geom in geometries:
+        h, w = (int(v) for v in geom.split("x"))
+        item = {"image1": np.zeros((h, w, 3), np.float32),
+                "image2": np.zeros((h, w, 3), np.float32)}
+        (res,) = engine.run_batch([item])
+        if carry_fn is not None:
+            carry_fn(res.flow_low)
+            engine.watch.mark_warm()  # expected compile, not drift
+    engine.reset_stats()  # warmup is not traffic
+
+
+def _serve_one(args) -> None:
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if not args.no_compile_cache:
+        from dexiraft_tpu.profiling import enable_persistent_cache
+
+        cache = enable_persistent_cache(args.compile_cache_dir)
+        print(f"[serve] compile cache: {cache}", flush=True)
+
+    cfg, variables = _load(args)
+
+    from dexiraft_tpu.eval_cli import _make_eval_fn
+    from dexiraft_tpu.serve import InferenceEngine
+    from dexiraft_tpu.serve.server import FlowService
+
+    eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters)
+    sessions_on = args.session_ttl_s > 0
+    engine = InferenceEngine(
+        eval_fn,
+        ServeConfig.from_args(args, mode=args.mode, warm_start=sessions_on),
+        mesh=mesh)
+    carry_fn = _make_carry_fn() if sessions_on else None
+    if args.warmup:
+        _warmup(engine, args.warmup.split(","), carry_fn)
+        print(f"[serve] warmup: compiled "
+              f"{engine.registry.compiles} signature(s)", flush=True)
+
+    service = FlowService(
+        engine,
+        host=args.host, port=args.port,
+        slo_ms=args.slo_ms, max_queue=args.max_queue,
+        session_ttl_s=args.session_ttl_s,
+        carry_fn=carry_fn,
+        request_timeout_s=args.request_timeout_s,
+        reuse_port=args.reuse_port)
+    service.install_signal_handlers()
+    service.start()
+    worker = os.environ.get("DEXIRAFT_SERVE_WORKER")
+    tag = f" (worker {worker})" if worker is not None else ""
+    print(f"[serve] listening on {service.url}{tag} — "
+          f"batch_size={args.batch_size} slo_ms={args.slo_ms:g} "
+          f"sessions={'on' if sessions_on else 'off'} "
+          f"strict={'on' if args.strict else 'off'}", flush=True)
+
+    try:
+        while not service.stopped.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        # second signal (or bare ^C before the handler ran): best-effort
+        # fast drain, then leave
+        service.drain_and_stop(timeout=5.0)
+    sched = service.scheduler.stats
+    print(f"[serve] stopped after {service.uptime_s():.1f}s — "
+          f"{sched.completed} served, {sched.rejected} shed, "
+          f"{sched.failed} failed; {engine.stats.summary()}", flush=True)
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(argv)
+    if args.workers < 1:
+        raise SystemExit(f"serve: --workers must be >= 1, got "
+                         f"{args.workers}")
+    if args.workers > 1:
+        _run_pool(args, argv)
+    else:
+        _serve_one(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -24,15 +24,7 @@ import jax
 import numpy as np
 
 from dexiraft_tpu import config as cfglib
-from dexiraft_tpu.config import RAFTConfig, TrainConfig
-
-VARIANTS = {
-    "v1": cfglib.raft_v1, "raft": cfglib.raft_v1,
-    "v2": cfglib.raft_v2, "early": cfglib.raft_v2,
-    "v3": cfglib.raft_v3, "separate": cfglib.raft_v3,
-    "v4": cfglib.raft_v4,
-    "v5": cfglib.raft_v5, "dual": cfglib.raft_v5,
-}
+from dexiraft_tpu.config import VARIANTS, RAFTConfig, TrainConfig
 
 # reference in-training validation iteration counts (evaluate.py:81-210)
 _VAL_ITERS = {"chairs": 24, "sintel": 32, "kitti": 24, "hd1k": 24}

@@ -1,13 +1,34 @@
 """Serving throughput — the eval-side analog of scripts/train_bench.py.
 
-Drives ONE mixed-geometry frame-pair stream through the throughput-mode
-inference engine (dexiraft_tpu.serve) at batch_size=1 (the reference
-per-image behavior) and at --batch, same jitted eval step, and emits ONE
-JSON record: frame-pairs/s per config, p50/p99 batch latency, bucket
-hit/compile counts (the mixed stream must compile EXACTLY once per
-bucket), peak in-flight depth, fetch-blocked time, and FLOPs/MFU from
-XLA's cost analysis. The speedup field is the acceptance signal:
-batched throughput over the batch-1 configuration of the same run.
+Two modes, one watchdogged script:
+
+**Engine mode** (default): drives ONE mixed-geometry frame-pair stream
+through the throughput-mode inference engine (dexiraft_tpu.serve) at
+batch_size=1 (the reference per-image behavior) and at --batch, same
+jitted eval step, and emits ONE JSON record: frame-pairs/s per config,
+p50/p99 batch latency, bucket hit/compile counts (the mixed stream must
+compile EXACTLY once per bucket), peak in-flight depth, fetch-blocked
+time, and FLOPs/MFU from XLA's cost analysis. The speedup field is the
+acceptance signal: batched throughput over the batch-1 configuration of
+the same run.
+
+**Closed-loop mode** (``--closed_loop``): a load generator against the
+REAL service (serve.server.FlowService over HTTP on loopback — request
+queue, SLO batching, sessions; the SERVE_r0* service record). Phases:
+  1. sequential baseline — a batch_size=1 service under closed-loop
+     load (each client waits for its response before sending the next),
+  2. goodput-vs-concurrency — the batched service at >= 2 closed-loop
+     concurrency levels, client-measured p50/p99 per level,
+  3. overload — OPEN arrivals at ``--overload_factor`` x the measured
+     batched goodput: admission control must shed with 503s while
+     goodput holds near capacity instead of collapsing,
+  4. session warm-start — a static synthetic stream posted K times
+     under one ``X-Session-Id``: chained carry approximates a K*iters
+     refinement, so the last warm response must sit measurably closer
+     to a K*iters reference than the cold single-request response does
+     (the service-side proof of the scripts/warmstart_bench.py win).
+The acceptance signals: ``speedup_batched_over_sequential > 1`` and
+``warm_start.warm_beats_cold``.
 
 Watchdog (the bench.py pattern, tests/test_bench_watchdog.py /
 tests/test_zserve_bench.py): the measurement runs in a CHILD process;
@@ -20,6 +41,9 @@ Usage: python scripts/serve_bench.py [--variant v1] [--small]
            [--batch 4] [--iters 4] [--sizes 40x56,44x60,36x52]
            [--frames 16] [--bucket_multiple 16] [--inflight 2]
            [--data_parallel 0] [--cpu] [--no_compile_cache]
+       python scripts/serve_bench.py --closed_loop [--size 96x128]
+           [--requests 32] [--concurrency 4] [--slo_ms 150]
+           [--overload_factor 4] [--warm_frames 4] [--cpu]
 """
 
 from __future__ import annotations
@@ -48,6 +72,27 @@ CONFIG_KEYS = {
     "flops_per_pair", "tflops_per_sec", "mfu",
 }
 
+# ---- closed-loop (service) record schema, pinned by
+# tests/test_zzserve_service.py ------------------------------------------
+CLOSED_LOOP_RECORD_KEYS = {
+    "metric", "platform", "variant", "iters", "size", "batch", "slo_ms",
+    "max_queue", "sequential", "levels", "overload", "warm_start",
+    "speedup_batched_over_sequential",
+}
+LEVEL_KEYS = {
+    "concurrency", "requests", "goodput_rps", "p50_ms", "p99_ms",
+    "rejected", "errors", "dispatch_full", "dispatch_slo",
+    "mean_batch_fill", "queue_peak",
+}
+OVERLOAD_KEYS = {
+    "offered_rps", "duration_s", "completed", "rejected", "errors",
+    "goodput_rps", "p99_ms",
+}
+WARM_KEYS = {
+    "frames", "iters", "iters_ref", "warm_dist", "cold_dist",
+    "warm_beats_cold",
+}
+
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
@@ -71,22 +116,42 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (config.update beats the "
                          "axon site-hook pin)")
+    # ---- closed-loop (service) mode ------------------------------------
+    ap.add_argument("--closed_loop", action="store_true",
+                    help="load-generate against the real FlowService over "
+                         "HTTP instead of driving the engine directly")
+    ap.add_argument("--size", default="96x128",
+                    help="closed-loop frame geometry HxW (one bucket: the "
+                         "service phases measure scheduling, not bucket "
+                         "spread — engine mode covers that)")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="closed-loop requests per concurrency level")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="highest closed-loop client count (levels are "
+                         "1 and this)")
+    ap.add_argument("--slo_ms", type=float, default=150.0,
+                    help="service latency budget (scheduler hold window)")
+    ap.add_argument("--max_queue", type=int, default=64,
+                    help="service admission bound (503 past it)")
+    ap.add_argument("--overload_factor", type=float, default=4.0,
+                    help="open-arrival offered rate as a multiple of the "
+                         "measured batched goodput")
+    ap.add_argument("--overload_duration_s", type=float, default=3.0)
+    ap.add_argument("--warm_frames", type=int, default=4,
+                    help="frames chained through one session for the "
+                         "warm-start convergence check")
     return ap
 
 
-def _measure() -> None:
-    args = build_parser().parse_args()
+def _build_eval_fn(args, iters=None):
+    """Model + jitted eval step + engine-contract eval_fn — shared by
+    the engine-mode measurement and the closed-loop service phases.
+    Returns (eval_fn, mesh, step, variables)."""
     import jax
-    import numpy as np
-
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
 
     from dexiraft_tpu import config as C
-    from dexiraft_tpu.analysis import guards
     from dexiraft_tpu.config import TrainConfig
     from dexiraft_tpu.profiling import enable_persistent_cache
-    from dexiraft_tpu.serve import InferenceEngine, ServeConfig
     from dexiraft_tpu.train.state import create_state
     from dexiraft_tpu.train.step import make_eval_step
 
@@ -94,7 +159,6 @@ def _measure() -> None:
         cache_dir = enable_persistent_cache(args.compile_cache_dir)
         print(f"compile cache: {cache_dir}", file=sys.stderr)
 
-    sizes = [tuple(int(v) for v in s.split("x")) for s in args.sizes.split(",")]
     cfg = getattr(C, f"raft_{args.variant}")(small=args.small)
     state = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     variables = {"params": state.params, "batch_stats": state.batch_stats}
@@ -107,17 +171,29 @@ def _measure() -> None:
         # params must live replicated on the mesh up front, or the
         # pinned replicated in_sharding re-transfers them every dispatch
         variables = replicate(variables, mesh)
-    step = make_eval_step(cfg, iters=args.iters, mesh=mesh)
+    step = make_eval_step(cfg, iters=iters or args.iters, mesh=mesh)
     if mesh is None:
         # explicit H2D puts: the engine hands host-stacked numpy
-        # batches; spelling the transfer keeps the strict region below
-        # (guards.strict_mode) clean without widening its teeth
+        # batches; spelling the transfer keeps the strict regions
+        # (guards.strict_mode) clean without widening their teeth
         put = jax.device_put
         eval_fn = lambda a, b, fi: step(
             variables, put(a), put(b),
             flow_init=None if fi is None else put(fi))
     else:
         eval_fn = lambda a, b, fi: step(variables, a, b, None, None, fi)
+    return eval_fn, mesh, step, variables
+
+
+def _measure(args) -> None:
+    import jax
+    import numpy as np
+
+    from dexiraft_tpu.analysis import guards
+    from dexiraft_tpu.serve import InferenceEngine, ServeConfig
+
+    sizes = [tuple(int(v) for v in s.split("x")) for s in args.sizes.split(",")]
+    eval_fn, mesh, step, variables = _build_eval_fn(args)
     print(f"platform={jax.devices()[0].platform} variant={args.variant} "
           f"small={args.small} iters={args.iters} sizes={args.sizes} "
           f"frames={args.frames} batch={args.batch} "
@@ -245,6 +321,313 @@ def _measure() -> None:
     print(json.dumps(record), flush=True)
 
 
+# ---- closed-loop (service) mode -----------------------------------------
+
+
+def _http_get_json(host: str, port: int, path: str) -> dict:
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", path)
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def _pctl_ms(samples, p: float) -> float:
+    import numpy as np
+
+    if not samples:
+        return 0.0
+    return round(float(np.percentile(samples, p)) * 1e3, 2)
+
+
+def _client_thread(host: str, port: int, body: bytes, n: int,
+                   latencies: list, rejects: list, session=None) -> None:
+    """One closed-loop client: POST, wait for the response, repeat.
+    Keep-alive (HTTP/1.1) — one connection per client, like a real
+    streaming caller. Appends per-request latency (s) or the reject
+    status code; list.append is atomic, no lock needed."""
+    import http.client
+
+    headers = {"Content-Type": "application/x-npz"}
+    if session:
+        headers["X-Session-Id"] = session
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        for _ in range(n):
+            t0 = time.monotonic()
+            conn.request("POST", "/v1/flow", body=body, headers=headers)
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 200:
+                latencies.append(time.monotonic() - t0)
+            else:
+                rejects.append(resp.status)
+    finally:
+        conn.close()
+
+
+def _run_level(service, body: bytes, concurrency: int, requests: int) -> dict:
+    """Closed-loop load at one concurrency level; the /stats?reset=1
+    scrape hands the measurement window off exactly like a monitoring
+    agent would (and pins that the reset path works under load)."""
+    import threading
+
+    host, port = service.address
+    latencies: list = []
+    rejects: list = []
+    per = [requests // concurrency] * concurrency
+    for i in range(requests % concurrency):
+        per[i] += 1
+    threads = [threading.Thread(target=_client_thread,
+                                args=(host, port, body, n, latencies, rejects))
+               for n in per if n]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    sched = _http_get_json(host, port, "/stats?reset=1")["scheduler"]
+    # "rejected" is ONLY admission shedding (503): folding 4xx/5xx or
+    # connection failures in would let an erroring service masquerade
+    # as one that is load-shedding gracefully
+    shed = sum(1 for s in rejects if s == 503)
+    out = {
+        "concurrency": concurrency,
+        "requests": requests,
+        "goodput_rps": round(len(latencies) / wall, 3) if wall else 0.0,
+        "p50_ms": _pctl_ms(latencies, 50),
+        "p99_ms": _pctl_ms(latencies, 99),
+        "rejected": shed,
+        "errors": len(rejects) - shed,
+        "dispatch_full": sched["dispatch_full"],
+        "dispatch_slo": sched["dispatch_slo"],
+        "mean_batch_fill": sched["mean_batch_fill"],
+        "queue_peak": sched["queue_peak"],
+    }
+    print(f"[closed c={concurrency}] {out['goodput_rps']} req/s, "
+          f"p50 {out['p50_ms']} / p99 {out['p99_ms']} ms, "
+          f"fill {out['mean_batch_fill']}, "
+          f"full/slo {out['dispatch_full']}/{out['dispatch_slo']}",
+          file=sys.stderr)
+    return out
+
+
+def _overload_sender(host: str, port: int, body: bytes, interval: float,
+                     offset: float, t_end: float,
+                     latencies: list, rejects: list) -> None:
+    """One open-loop sender: fires on an absolute schedule (t0 + offset
+    + k*interval) regardless of completions — if a request runs long the
+    next one is already late and goes out immediately, preserving the
+    offered rate. Keep-alive connection, reopened on error."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    t0 = time.monotonic()
+    k = 0
+    try:
+        while True:
+            nxt = t0 + offset + k * interval
+            pause = nxt - time.monotonic()
+            if pause > 0:
+                time.sleep(pause)
+            if time.monotonic() >= t_end:
+                return
+            k += 1
+            t_req = time.monotonic()
+            try:
+                conn.request("POST", "/v1/flow", body=body,
+                             headers={"Content-Type": "application/x-npz"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    latencies.append(time.monotonic() - t_req)
+                else:
+                    rejects.append(resp.status)
+            except Exception:
+                rejects.append(-1)
+                conn.close()
+                conn = http.client.HTTPConnection(host, port, timeout=60)
+    finally:
+        conn.close()
+
+
+def _run_overload(service, body: bytes, offered_rps: float,
+                  duration_s: float) -> dict:
+    """OPEN arrivals at a fixed offered rate (no back-pressure from
+    completions): admission control must shed the excess with 503s and
+    keep goodput near capacity — the queue-collapse counterexample.
+    A FIXED pool of senders paces the rate (a thread per arrival would
+    exhaust threads/fds at the offered rates real hardware produces)."""
+    import threading
+
+    host, port = service.address
+    latencies: list = []
+    rejects: list = []
+    senders = max(4, min(64, int(offered_rps * 0.5)))
+    interval = senders / max(offered_rps, 1e-6)
+    t_end = time.monotonic() + duration_s
+    threads = [threading.Thread(
+        target=_overload_sender,
+        args=(host, port, body, interval, i * interval / senders, t_end,
+              latencies, rejects))
+        for i in range(senders)]
+    t0 = time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.monotonic() - t0
+    shed = sum(1 for s in rejects if s == 503)
+    out = {
+        "offered_rps": round(offered_rps, 3),
+        "duration_s": round(duration_s, 3),
+        "completed": len(latencies),
+        "rejected": shed,
+        "errors": len(rejects) - shed,
+        "goodput_rps": round(len(latencies) / wall, 3) if wall else 0.0,
+        "p99_ms": _pctl_ms(latencies, 99),
+    }
+    _http_get_json(host, port, "/stats?reset=1")
+    print(f"[overload] offered {out['offered_rps']} req/s for "
+          f"{duration_s:g}s: {out['completed']} served, "
+          f"{out['rejected']} shed / {out['errors']} errored, "
+          f"goodput {out['goodput_rps']} req/s",
+          file=sys.stderr)
+    return out
+
+
+def _measure_closed_loop(args) -> None:
+    import threading
+
+    import jax
+    import numpy as np
+
+    from dexiraft_tpu.data.padder import InputPadder
+    from dexiraft_tpu.serve import InferenceEngine, ServeConfig, bucket_shape
+    from dexiraft_tpu.serve.server import (FlowService, decode_response,
+                                           encode_request)
+
+    h, w = (int(v) for v in args.size.split("x"))
+    rng = np.random.default_rng(0)
+    im1 = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    im2 = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    body = encode_request(im1, im2)
+
+    eval_fn, mesh, step, variables = _build_eval_fn(args)
+    print(f"platform={jax.devices()[0].platform} variant={args.variant} "
+          f"small={args.small} iters={args.iters} size={args.size} "
+          f"batch={args.batch} slo_ms={args.slo_ms:g} "
+          f"concurrency={args.concurrency}", file=sys.stderr)
+
+    def make_service(batch_size: int, warm: bool) -> FlowService:
+        engine = InferenceEngine(
+            eval_fn,
+            ServeConfig(batch_size=batch_size, mode="sintel",
+                        bucket_multiple=args.bucket_multiple,
+                        inflight=args.inflight, warm_start=warm),
+            mesh=mesh)
+        svc = FlowService(engine, port=0, slo_ms=args.slo_ms,
+                          max_queue=args.max_queue,
+                          session_ttl_s=60.0 if warm else 0.0,
+                          request_timeout_s=60.0)
+        svc.start()
+        # warmup: compile the one bucket signature outside any timed
+        # window, then hand off a clean measurement window
+        _client_thread(*svc.address, body, 1, [], [])
+        svc.reset_stats()
+        return svc
+
+    # -- phase 1: sequential baseline (batch_size=1 service) -------------
+    seq_svc = make_service(1, warm=False)
+    sequential = _run_level(seq_svc, body, args.concurrency, args.requests)
+    seq_svc.drain_and_stop()
+
+    # -- phases 2-4 share the batched, session-enabled service ----------
+    svc = make_service(args.batch, warm=True)
+    levels = [_run_level(svc, body, c, args.requests)
+              for c in sorted({1, args.concurrency})]
+    batched_rps = levels[-1]["goodput_rps"]
+
+    overload = _run_overload(svc, body,
+                             args.overload_factor * max(batched_rps, 0.5),
+                             args.overload_duration_s)
+
+    # -- phase 4: session warm-start convergence --------------------------
+    # K chained warm requests ~ K*iters refinement (each frame seeds the
+    # next through the session carry), so the K-th warm response must be
+    # closer to a K*iters reference than the cold 1*iters response is
+    import http.client
+
+    host, port = svc.address
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    flow_cold = flow_warm = None
+    try:
+        for k in range(args.warm_frames):
+            conn.request("POST", "/v1/flow", body=body,
+                         headers={"X-Session-Id": "warm-bench"})
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200, (resp.status, data)
+            if k == 0:
+                flow_cold = decode_response(data)  # first frame IS cold
+            flow_warm = decode_response(data)
+    finally:
+        conn.close()
+
+    ref_eval_fn, _, _, _ = _build_eval_fn(
+        args, iters=args.iters * args.warm_frames)
+    bucket = bucket_shape(h, w, multiple=args.bucket_multiple)
+    padder = InputPadder(im1.shape, mode="sintel", target=bucket)
+    _, up_ref = ref_eval_fn(padder.pad(im1)[0][None],
+                            padder.pad(im2)[0][None], None)
+    flow_ref = padder.unpad(jax.device_get(up_ref)[0])
+    warm_dist = float(np.mean(np.abs(flow_warm - flow_ref)))
+    cold_dist = float(np.mean(np.abs(flow_cold - flow_ref)))
+    warm_start = {
+        "frames": args.warm_frames,
+        "iters": args.iters,
+        "iters_ref": args.iters * args.warm_frames,
+        "warm_dist": round(warm_dist, 4),
+        "cold_dist": round(cold_dist, 4),
+        "warm_beats_cold": warm_dist < cold_dist,
+    }
+    print(f"[warm] dist-to-{warm_start['iters_ref']}-iter-ref: "
+          f"cold {cold_dist:.4f} vs warm {warm_dist:.4f} "
+          f"({'WIN' if warm_dist < cold_dist else 'NO WIN'})",
+          file=sys.stderr)
+
+    svc.drain_and_stop()
+
+    record = {
+        "metric": "serve_closed_loop",
+        "platform": jax.devices()[0].platform,
+        "variant": args.variant + ("-small" if args.small else ""),
+        "iters": args.iters,
+        "size": args.size,
+        "batch": args.batch,
+        "slo_ms": args.slo_ms,
+        "max_queue": args.max_queue,
+        "sequential": sequential,
+        "levels": levels,
+        "overload": overload,
+        "warm_start": warm_start,
+        "speedup_batched_over_sequential": (
+            round(batched_rps / sequential["goodput_rps"], 3)
+            if sequential["goodput_rps"] else None),
+    }
+    assert set(record) == CLOSED_LOOP_RECORD_KEYS, \
+        sorted(set(record) ^ CLOSED_LOOP_RECORD_KEYS)
+    assert set(sequential) == LEVEL_KEYS
+    assert all(set(lv) == LEVEL_KEYS for lv in levels)
+    assert set(overload) == OVERLOAD_KEYS
+    assert set(warm_start) == WARM_KEYS
+    print(json.dumps(record), flush=True)
+
+
 def main() -> int:
     """Parent: spawn the measurement child under the stall watchdog.
     No jax import on this side — a wedged backend can only hang the
@@ -321,6 +704,11 @@ if __name__ == "__main__":
             print("fake child hanging", file=sys.stderr, flush=True)
             while True:
                 time.sleep(3600)
-        _measure()
+        _args = build_parser().parse_args()
+        if _args.cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        (_measure_closed_loop if _args.closed_loop else _measure)(_args)
         sys.exit(0)
     sys.exit(main())
