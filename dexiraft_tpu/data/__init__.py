@@ -4,6 +4,11 @@ TPU-first re-design of the reference's torch DataLoader stack
 (core/datasets.py, core/utils/augmentor.py, core/utils/frame_utils.py):
 pure numpy samples with explicit PRNG, per-host sharded batches, and a
 threaded prefetcher that keeps the chips fed.
+
+The packed-record data plane (sharded record files + manifest + the
+RecordLoader serving the same Loader.batches contract with O(1) resume
+seeks) lives in the ``dexiraft_tpu.data.records`` subpackage
+(docs/data_plane.md).
 """
 
 from dexiraft_tpu.data.augment import ColorJitter, FlowAugmentor, SparseFlowAugmentor
@@ -25,7 +30,7 @@ from dexiraft_tpu.data.flow_io import (
     write_flo,
     write_flow_kitti,
 )
-from dexiraft_tpu.data.loader import Loader
+from dexiraft_tpu.data.loader import Loader, epoch_permutation
 from dexiraft_tpu.data.padder import InputPadder
 from dexiraft_tpu.data.prefetch import (
     DevicePrefetcher,
@@ -52,6 +57,7 @@ __all__ = [
     "write_flow_kitti",
     "read_gen",
     "Loader",
+    "epoch_permutation",
     "InputPadder",
     "DevicePrefetcher",
     "PrefetchStats",

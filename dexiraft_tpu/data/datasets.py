@@ -32,6 +32,12 @@ def data_root(name: str) -> str:
     return osp.join(base, name)
 
 
+# the sintel-stage mixture selector train_cli implicitly trains with;
+# a records pack made with a different one is a different sample
+# sequence, which the --records_dir provenance check refuses
+DEFAULT_TRAIN_DS = "C+T+K+S+H"
+
+
 class FlowDataset:
     """Base dataset: (image pair, flow[, valid]) with optional augmentation."""
 
@@ -322,7 +328,7 @@ class EdgePairDataset(FlowDataset):
 
 
 def fetch_dataset(stage: str, image_size: Sequence[int],
-                  train_ds: str = "C+T+K+S+H",
+                  train_ds: str = DEFAULT_TRAIN_DS,
                   edge_root: Optional[str] = None):
     """Stage-keyed training mixture (core/datasets.py:202-237).
 

@@ -37,6 +37,26 @@ import numpy as np
 Batch = Dict[str, np.ndarray]
 
 
+def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """THE deterministic global-shuffle contract of the data plane.
+
+    The epoch-``epoch`` visit order over ``n`` samples is a pure function
+    of ``(seed, epoch)``: stable across processes and platforms for a
+    given numpy version, so a restarted process, a packer verifying host
+    slices offline, and every host of a multi-host mesh all derive the
+    SAME permutation with no communication. (NEP 19 reserves the right
+    to change Generator streams between numpy feature releases — all
+    hosts of one run, and a resumed run, must use the same numpy
+    version, which any pinned pod image already guarantees.) Exact-resume (resilience.stream) and per-host input
+    sharding (Loader.batches slicing host-disjoint windows of this
+    order) both lean on this function and nothing else; it is pinned by
+    tests/test_zzzdata_records.py including across a process restart.
+    """
+    order = np.arange(n)
+    np.random.default_rng((seed, epoch)).shuffle(order)
+    return order
+
+
 def _stack(samples) -> Batch:
     keys = [k for k in samples[0] if k != "extra_info"]
     return {k: np.stack([s[k] for s in samples]) for k in keys}
@@ -297,14 +317,23 @@ class Loader:
         return n
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
-        order = np.arange(len(self.dataset))
         if self.shuffle:
-            np.random.default_rng((self.seed, epoch)).shuffle(order)
-        return order
+            return epoch_permutation(self.seed, epoch, len(self.dataset))
+        return np.arange(len(self.dataset))
 
     def _decode(self, epoch: int, index: int) -> Batch:
         rng = np.random.default_rng((self.seed, epoch, index))
         return self.dataset.sample(int(index), rng)
+
+    def _note_decode_ok(self) -> None:
+        """Hook: a sample decoded successfully (RecordLoader counts
+        record reads here; the base loader keeps no per-success stat)."""
+
+    def _note_decode_error(self, exc: BaseException) -> None:
+        """Hook: one decode attempt failed with ``exc`` — called BEFORE
+        the retry/skip accounting, so subclasses can classify the fault
+        (e.g. RecordLoader counting CRC failures) without changing the
+        retry discipline."""
 
     def _resolve(self, pools: _PoolManager, epoch: int, index: int, fut):
         """One sample's result, with bounded retry: pool breakage
@@ -315,6 +344,7 @@ class Loader:
             try:
                 sample = fut.result()
                 pools.note_success()
+                self._note_decode_ok()
                 return sample
             except BrokenExecutor:
                 # the pool died under this future; rebuild the future's
@@ -324,8 +354,8 @@ class Loader:
                 # its worker must exhaust the budget, not rebuild pools
                 # forever
                 pools.rebuild(getattr(fut, "pool_generation", 0))
-            except Exception:
-                pass  # plain decode failure; retry below
+            except Exception as e:
+                self._note_decode_error(e)  # classify, then retry below
             attempt += 1
             if attempt > self.max_retries:
                 self.stats.skipped_samples += 1

@@ -11,6 +11,11 @@ ways a multi-day run dies or silently degrades:
   * stream.StreamPosition — the data-stream position (epoch, batch
     offset) is checkpointed alongside the train state, so --resume
     continues the EXACT sample sequence instead of replaying epoch 0.
+    The sidecar also records which data plane was feeding the run
+    (loader_kind raw|records): resuming under the other one raises
+    LoaderKindMismatch instead of silently changing the sequence. On
+    the packed-record plane (data.records) the resumed position is an
+    O(1) shard-index seek, not a re-decode.
   * verify.restore_verified — restore-time integrity check (tree
     structure + leaf shapes + finiteness sample) with fallback to the
     previous step: a truncated or poisoned checkpoint degrades to an
@@ -30,6 +35,7 @@ from dexiraft_tpu.data.loader import PipelineStats
 from dexiraft_tpu.resilience.preemption import PreemptionHandler
 from dexiraft_tpu.resilience.retention import RetentionPolicy
 from dexiraft_tpu.resilience.stream import (
+    LoaderKindMismatch,
     StreamPosition,
     delete_position,
     load_position,
@@ -43,6 +49,7 @@ from dexiraft_tpu.resilience.verify import (
 
 __all__ = [
     "CheckpointIntegrityError",
+    "LoaderKindMismatch",
     "PipelineStats",
     "PreemptionHandler",
     "RetentionPolicy",

@@ -87,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--validation", nargs="*", default=None,
                    choices=sorted(_VAL_ITERS),
                    help="default: the preset's per-stage validation sets")
+    p.add_argument("--records_dir", default=None,
+                   help="train from a packed-record directory "
+                        "(scripts/pack_records.py) instead of decoding "
+                        "raw dataset files: same sample sequence, O(1) "
+                        "resume seeks, per-host shard reads "
+                        "(docs/data_plane.md); the raw-file loader "
+                        "remains the default")
     p.add_argument("--edge_root", default=None,
                    help="parallel tree of precomputed edge-map PNGs for the "
                         "v2/v3 data-edge contract (core/datasets_seperate.py)")
@@ -249,6 +256,7 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     from dexiraft_tpu.data.prefetch import prefetch_to_device
     from dexiraft_tpu.parallel.mesh import make_mesh
     from dexiraft_tpu.resilience import (
+        LoaderKindMismatch,
         PreemptionHandler,
         RetentionPolicy,
         StreamPosition,
@@ -287,6 +295,47 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     # rollback target. A stale dir from a previous experiment must never
     # be spliced into a fresh run by the guard.
     last_saved = None
+    # which data plane feeds this run; stamped into every stream sidecar
+    # (kind + pack fingerprint) so --resume refuses a raw<->records swap
+    # AND a records-to-different-pack swap (LoaderKindMismatch)
+    loader_kind = "records" if args.records_dir else "raw"
+    records_ds = None
+    pack_fingerprint = None
+    if args.records_dir:
+        # packed-record data plane (docs/data_plane.md), opened BEFORE
+        # the resume path so its provenance gates both the dataset
+        # selection and the stream-sidecar check. Same sample sequence
+        # as the raw loader; decode is an O(1) indexed shard read and
+        # each host touches only its slice's records.
+        if args.edge_root:
+            sys.exit("--records_dir cannot be combined with --edge_root: "
+                     "edge-paired stages are not packable "
+                     "(scripts/pack_records.py) — use the raw loader")
+        from dexiraft_tpu.data.datasets import DEFAULT_TRAIN_DS
+        from dexiraft_tpu.data.records import open_records
+
+        records_ds = open_records(args.records_dir)
+        man = records_ds.manifest
+        if man.stage is not None and man.stage != tc.stage:
+            sys.exit(f"--records_dir {args.records_dir} was packed from "
+                     f"stage {man.stage!r} but this run trains stage "
+                     f"{tc.stage!r} — pack the right stage or drop "
+                     f"--records_dir")
+        # the raw path always trains sintel with the default mixture
+        # selector; a pack of a reduced mixture is a DIFFERENT epoch
+        if (tc.stage == "sintel" and man.train_ds is not None
+                and man.train_ds != DEFAULT_TRAIN_DS):
+            sys.exit(f"--records_dir {args.records_dir} was packed with "
+                     f"train_ds={man.train_ds!r} but the sintel stage "
+                     f"trains the {DEFAULT_TRAIN_DS!r} mixture — repack "
+                     f"with the default selector or use the raw loader")
+        if (man.image_size is not None
+                and tuple(man.image_size) != tuple(tc.image_size)):
+            print(f"[records] WARNING: pack was made at image_size "
+                  f"{tuple(man.image_size)}, run requests "
+                  f"{tuple(tc.image_size)}; the pack-time crop recipe "
+                  f"wins (repack to change it)")
+        pack_fingerprint = man.fingerprint
     # position of the NEXT global batch to consume (resilience.stream):
     # checkpointed as a sidecar with every save, so --resume continues
     # the exact sample sequence instead of replaying from epoch 0
@@ -295,7 +344,12 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         # verified restore: a truncated/poisoned newest step falls back
         # to the previous one with a message instead of crashing here
         state, last_saved = restore_verified(ckpt_dir, state)
-        pos = load_position(ckpt_dir, last_saved, seed=tc.seed)
+        try:
+            pos = load_position(ckpt_dir, last_saved, seed=tc.seed,
+                                loader_kind=loader_kind,
+                                fingerprint=pack_fingerprint)
+        except LoaderKindMismatch as e:
+            sys.exit(f"[resume] {e}")
         if pos is not None:
             stream_pos = pos
         print(f"Resumed full state at step "
@@ -311,13 +365,23 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         print(f"Partial restore from {args.restore_ckpt} "
               f"({len(skipped)} leaves fresh)")
 
-    dataset = fetch_dataset(tc.stage, tc.image_size,
-                            edge_root=args.edge_root)
-    print(f"Training with {len(dataset)} image pairs")
-    loader = Loader(
-        dataset, tc.batch_size, seed=tc.seed, num_workers=args.num_workers,
+    loader_kwargs = dict(
+        seed=tc.seed, num_workers=args.num_workers,
         worker_mode=args.worker_mode, mp_start_method="spawn",
         process_index=jax.process_index(), process_count=jax.process_count())
+    if records_ds is not None:
+        from dexiraft_tpu.data.records import RecordLoader
+
+        man = records_ds.manifest
+        print(f"Training with {len(records_ds)} packed samples "
+              f"({man.num_records} records in {len(man.shards)} shard(s), "
+              f"fingerprint {man.fingerprint[:12]})")
+        loader = RecordLoader(records_ds, tc.batch_size, **loader_kwargs)
+    else:
+        dataset = fetch_dataset(tc.stage, tc.image_size,
+                                edge_root=args.edge_root)
+        print(f"Training with {len(dataset)} image pairs")
+        loader = Loader(dataset, tc.batch_size, **loader_kwargs)
     batches_per_epoch = max(len(loader), 1)
 
     step_fn = make_train_step(cfg, tc, mesh=mesh)
@@ -366,7 +430,9 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         # strict transfer guard
         with jax.transfer_guard("allow"):
             ckpt.save_checkpoint(ckpt_dir, state, step=step)
-            save_position(ckpt_dir, step, stream_pos, seed=tc.seed)
+            save_position(ckpt_dir, step, stream_pos, seed=tc.seed,
+                          loader_kind=loader_kind,
+                          fingerprint=pack_fingerprint)
         last_saved = step
         retention.apply(ckpt_dir, protect=(last_saved,))
 

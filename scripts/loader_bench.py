@@ -12,8 +12,19 @@ The training step is host-bound only if its on-chip steps/sec exceeds
 the batches/sec printed here; the margin is the headroom for scaling
 batch or worker count. CPU-only — no TPU required.
 
+--records switches to the packed-record A/B (docs/data_plane.md): a
+synthetic SINTEL tree (PNG frames — the compressed decode that
+dominates the real Sintel/Things/KITTI/HD1K stages; chairs' raw-binary
+PPM is the one format with near-zero decode cost) is packed once via
+data.records.pack_dataset, then the raw-decode Loader and the
+RecordLoader run the identical recipe. One JSON record carries both
+sides — steady-state samples/s AND the resume-seek latency (time from
+`batches(start_epoch=, start_offset=)` to the first batch of a
+mid-epoch resume) — so the packed path's win is measured, not asserted.
+
 Usage: python scripts/loader_bench.py [--pairs 48] [--batches 60]
        [--batch 6] [--workers 1 4 8] [--height 384] [--width 512]
+       python scripts/loader_bench.py --records [--shards 4] [...]
 """
 
 from __future__ import annotations
@@ -53,6 +64,130 @@ def build_chairs_tree(root: str, pairs: int, h: int, w: int) -> str:
     return data
 
 
+def build_sintel_tree(root: str, pairs: int, h: int, w: int) -> str:
+    """Synthetic Sintel layout: training/clean/scene_0/frame_NNNN.png
+    (pairs+1 consecutive frames) + training/flow/scene_0/frame_NNNN.flo."""
+    import os
+
+    import imageio.v2 as imageio
+
+    from dexiraft_tpu.data.flow_io import write_flo
+
+    img_dir = osp.join(root, "training", "clean", "scene_0")
+    flow_dir = osp.join(root, "training", "flow", "scene_0")
+    os.makedirs(img_dir)
+    os.makedirs(flow_dir)
+    rng = np.random.default_rng(0)
+    for i in range(pairs + 1):
+        img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        imageio.imwrite(osp.join(img_dir, f"frame_{i:04d}.png"), img)
+        if i < pairs:
+            write_flo(osp.join(flow_dir, f"frame_{i:04d}.flo"),
+                      rng.normal(scale=4.0, size=(h, w, 2))
+                      .astype(np.float32))
+    return root
+
+
+# pinned schema of the --records A/B record (tests/test_zzzdata_records.py)
+RECORDS_AB_KEYS = ("metric", "raw", "records", "samples_per_sec_speedup",
+                   "resume_latency_speedup", "batch", "crop", "pairs",
+                   "shards", "num_workers")
+RECORDS_SIDE_KEYS = ("samples_per_sec", "batches_per_sec", "mb_per_sec",
+                     "resume_latency_s")
+
+
+def _measure_side(loader, batch: int, batches: int):
+    """Steady-state throughput + mid-epoch resume-seek latency for one
+    loader (raw or records); fresh iterators so pools start cold-fair."""
+    it = loader.batches()
+    for _ in range(3):  # warm the pool + page cache
+        next(it)
+    t0 = time.perf_counter()
+    nbytes = 0
+    for _ in range(batches):
+        nbytes += sum(v.nbytes for v in next(it).values())
+    dt = time.perf_counter() - t0
+    it.close()
+
+    # resume-seek: position the stream mid-epoch-1 (the exact-resume
+    # path train_cli --resume takes) and time to the FIRST batch out —
+    # the raw path re-decodes its slice from source files, the record
+    # path seeks the shard index; best of 3 to shed scheduler noise
+    offset = max(1, len(loader) // 2)
+    lat = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        it = loader.batches(start_epoch=1, start_offset=offset)
+        next(it)
+        lat.append(time.perf_counter() - t0)
+        it.close()
+    return {"samples_per_sec": round(batches * batch / dt, 2),
+            "batches_per_sec": round(batches / dt, 2),
+            "mb_per_sec": round(nbytes / dt / 1e6, 1),
+            "resume_latency_s": round(min(lat), 4)}
+
+
+def run_records_ab(args) -> None:
+    """A/B: raw-decode Loader vs packed RecordLoader, one JSON record."""
+    from dexiraft_tpu.data.datasets import MpiSintel
+    from dexiraft_tpu.data.loader import Loader
+    from dexiraft_tpu.data.records import (
+        RecordLoader,
+        pack_dataset,
+        verify_records,
+    )
+
+    crop = args.crop or (min(368, args.height - 16),
+                         min(496, args.width - 16))
+    workers = args.workers[0]
+    with tempfile.TemporaryDirectory() as tmp:
+        t0 = time.perf_counter()
+        build_sintel_tree(tmp, args.pairs, args.height, args.width)
+        # sintel-stage augmentation recipe (datasets.py:_fetch_plain)
+        aug = dict(crop_size=tuple(crop), min_scale=-0.2, max_scale=0.6,
+                   do_flip=True)
+        ds = MpiSintel(aug, split="training", root=tmp, dstype="clean")
+        records_dir = osp.join(tmp, "records")
+        manifest = pack_dataset(ds, records_dir, num_shards=args.shards,
+                                stage="sintel", image_size=crop)
+        problems = verify_records(records_dir)
+        if problems:
+            raise SystemExit(f"pack verify failed: {problems}")
+        print(f"[loader_bench] packed {manifest.num_records} records "
+              f"({sum(s.bytes for s in manifest.shards) / 1e6:.1f} MB, "
+              f"{len(manifest.shards)} shards) in "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+        sides = {}
+        for name, loader in [
+            ("raw", Loader(ds, args.batch, seed=7, num_workers=workers,
+                           prefetch=2 * workers)),
+            ("records", RecordLoader(records_dir, args.batch, seed=7,
+                                     num_workers=workers,
+                                     prefetch=2 * workers)),
+        ]:
+            sides[name] = _measure_side(loader, args.batch, args.batches)
+
+        rec = {
+            "metric": "records_ab",
+            "raw": sides["raw"],
+            "records": sides["records"],
+            "samples_per_sec_speedup": round(
+                sides["records"]["samples_per_sec"]
+                / sides["raw"]["samples_per_sec"], 2),
+            "resume_latency_speedup": round(
+                sides["raw"]["resume_latency_s"]
+                / max(sides["records"]["resume_latency_s"], 1e-9), 2),
+            "batch": args.batch,
+            "crop": list(crop),
+            "pairs": args.pairs,
+            "shards": len(manifest.shards),
+            "num_workers": workers,
+        }
+        assert tuple(rec) == RECORDS_AB_KEYS
+        print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pairs", type=int, default=48)
@@ -66,7 +201,17 @@ def main() -> None:
     ap.add_argument("--crop", type=int, nargs=2, default=None,
                     help="crop size (default: chairs recipe 368x496, "
                     "clamped to the synthetic geometry)")
+    ap.add_argument("--records", action="store_true",
+                    help="A/B the packed-record plane against raw decode "
+                         "(samples/s + resume-seek latency, one JSON "
+                         "record; uses the FIRST --workers value)")
+    ap.add_argument("--shards", type=int, default=4,
+                    help="--records: shard-file count for the pack")
     args = ap.parse_args()
+
+    if args.records:
+        run_records_ab(args)
+        return
 
     from dexiraft_tpu.data.datasets import FlyingChairs
     from dexiraft_tpu.data.loader import Loader

@@ -1,0 +1,382 @@
+"""Packed-record data plane (dexiraft_tpu/data/records, docs/data_plane.md).
+
+Pins the contracts the multi-host story stands on: pack->read
+bit-exactness against FlowDataset.sample, CRC-corruption skip+count
+through PR 4's retry discipline, seek-resume parity with the fresh-run
+sequence, the two-host disjoint-cover property, the epoch permutation
+as a pure function of (seed, epoch) ACROSS process restarts, the
+packer's --verify audit, and the stream sidecar's loader_kind refusal.
+
+Named zzz* to sort last (tier-1 budget discipline); everything runs on
+a 6-pair synthetic chairs tree at 96x128 — seconds, not minutes.
+"""
+
+import json
+import os.path as osp
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dexiraft_tpu.data.datasets import FlyingChairs
+from dexiraft_tpu.data.flow_io import write_flo
+from dexiraft_tpu.data.loader import Loader, epoch_permutation
+from dexiraft_tpu.data.records import (
+    RecordCorruptError,
+    RecordLoader,
+    RecordShardReader,
+    load_manifest,
+    open_records,
+    pack_dataset,
+    verify_records,
+)
+from dexiraft_tpu.resilience.stream import (
+    LoaderKindMismatch,
+    StreamPosition,
+    load_position,
+    save_position,
+)
+
+AUG = dict(crop_size=(64, 96), min_scale=-0.1, max_scale=1.0, do_flip=True)
+
+
+def _make_chairs_tree(root, n=6, h=96, w=128):
+    import imageio.v2 as imageio
+
+    data = root / "data"
+    data.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        for k in (1, 2):
+            imageio.imwrite(data / f"{i:05d}_img{k}.ppm",
+                            rng.integers(0, 256, (h, w, 3), dtype=np.uint8))
+        write_flo(data / f"{i:05d}_flow.flo",
+                  rng.normal(size=(h, w, 2)).astype(np.float32))
+    (root / "chairs_split.txt").write_text("\n".join(["1"] * n))
+    return data
+
+
+@pytest.fixture(scope="module")
+def packed(tmp_path_factory):
+    """One shared (raw dataset, records_dir) pair for the module."""
+    root = tmp_path_factory.mktemp("records_plane")
+    data = _make_chairs_tree(root)
+    ds = FlyingChairs(AUG, split="training", root=str(data))
+    records_dir = root / "records"
+    manifest = pack_dataset(2 * ds, str(records_dir), num_shards=3,
+                            stage="chairs", image_size=AUG["crop_size"])
+    return ds, str(records_dir), manifest
+
+
+class TestPackRoundTrip:
+    def test_bit_exact_vs_flow_dataset_sample(self, packed):
+        """The tentpole contract: for any (index, rng) the record path
+        returns byte-identical samples to the raw stage — repeats,
+        augmentation, and derived valid masks included."""
+        ds, records_dir, _ = packed
+        raw_mix = 2 * ds
+        rds = open_records(records_dir)
+        assert len(rds) == len(raw_mix) == 12
+        for i in range(len(rds)):
+            a = raw_mix.sample(i, np.random.default_rng((7, 0, i)))
+            b = rds.sample(i, np.random.default_rng((7, 0, i)))
+            assert set(a) == set(b)
+            for k in a:
+                assert a[k].dtype == b[k].dtype
+                np.testing.assert_array_equal(a[k], b[k])
+
+    def test_unaugmented_raw_arrays_round_trip(self, packed):
+        ds, records_dir, _ = packed
+        rds = open_records(records_dir, augment=False)
+        raw = ds._load_raw(3)
+        rec = rds._load_raw(3)
+        assert rec["image1"].dtype == np.uint8
+        for k in raw:
+            np.testing.assert_array_equal(raw[k], rec[k])
+
+    def test_manifest_schema(self, packed):
+        _, records_dir, manifest = packed
+        m = load_manifest(records_dir)
+        assert m.num_records == 6 and m.num_samples == 12
+        assert m.stage == "chairs" and m.image_size == (64, 96)
+        assert [s.records for s in m.shards] == [2, 2, 2]
+        assert len(m.members) == 1
+        mem = m.members[0]
+        assert mem.records == (0, 6) and mem.repeat == 2 and not mem.sparse
+        assert mem.aug == {"crop_size": [64, 96], "min_scale": -0.1,
+                           "max_scale": 1.0, "do_flip": True}
+        assert m.keys["image1"]["dtype"] == "uint8"
+        assert m.keys["flow"]["dtype"] == "float32"
+        assert m.fingerprint == manifest.fingerprint
+
+    def test_reader_seek_and_random_access(self, packed):
+        _, records_dir, manifest = packed
+        path = osp.join(records_dir, manifest.shards[0].file)
+        with RecordShardReader(path) as r:
+            sequential = list(iter(r))
+            assert len(sequential) == 2
+            # random access matches sequential, any order
+            for i in (1, 0, 1):
+                np.testing.assert_array_equal(r.read(i)["flow"],
+                                              sequential[i]["flow"])
+            r.seek(1)  # O(1) reposition of the sequential cursor
+            np.testing.assert_array_equal(next(iter(r))["image1"],
+                                          sequential[1]["image1"])
+
+
+class TestShardNaming:
+    def test_of_count_matches_files_written(self, packed, tmp_path):
+        """6 records at --shards 4 packs 3 shards of 2 — every file
+        must say -of-00003, not lie about a fourth that never existed."""
+        ds, _, _ = packed
+        m = pack_dataset(ds, str(tmp_path / "uneven"), num_shards=4)
+        assert [s.records for s in m.shards] == [2, 2, 2]
+        assert all(s.file.endswith("-of-00003.rec") for s in m.shards)
+        assert verify_records(str(tmp_path / "uneven")) == []
+
+
+class TestVerify:
+    def test_fresh_pack_verifies_clean(self, packed):
+        _, records_dir, _ = packed
+        assert verify_records(records_dir) == []
+
+    def test_corruption_caught(self, packed, tmp_path):
+        import shutil
+
+        _, records_dir, manifest = packed
+        bad_dir = tmp_path / "bad"
+        shutil.copytree(records_dir, bad_dir)
+        shard = bad_dir / manifest.shards[1].file
+        blob = bytearray(shard.read_bytes())
+        blob[200] ^= 0xFF  # flip one payload byte
+        shard.write_bytes(bytes(blob))
+        problems = verify_records(str(bad_dir))
+        assert problems and any("CRC" in p or "record" in p
+                                for p in problems)
+
+
+class TestCorruptionDiscipline:
+    def test_crc_failure_skips_and_counts(self, packed, tmp_path):
+        """A flipped bit on disk degrades one sample (retry -> skip ->
+        backfill) and shows up in records/* stats — never kills the run."""
+        import shutil
+
+        _, records_dir, manifest = packed
+        bad_dir = tmp_path / "bad_loader"
+        shutil.copytree(records_dir, bad_dir)
+        shard = bad_dir / manifest.shards[0].file
+        blob = bytearray(shard.read_bytes())
+        blob[100] ^= 0xFF
+        shard.write_bytes(bytes(blob))
+
+        loader = RecordLoader(str(bad_dir), 12, seed=3, num_workers=2,
+                              max_retries=1, retry_backoff_s=0.0)
+        it = loader.batches()
+        batch = next(it)  # every sample requested; corrupt one backfilled
+        it.close()
+        assert batch["image1"].shape[0] == 12
+        assert loader.stats.record_crc_failures >= 1
+        assert loader.stats.skipped_samples >= 1
+        assert loader.stats.retries >= 1
+        d = loader.stats.as_dict()
+        assert d["records/crc_failures"] == loader.stats.record_crc_failures
+        assert d["records/reads"] > 0
+        assert "CRC" in loader.stats.summary()
+
+    def test_reader_raises_record_corrupt(self, packed, tmp_path):
+        import shutil
+
+        _, records_dir, manifest = packed
+        bad_dir = tmp_path / "bad_reader"
+        shutil.copytree(records_dir, bad_dir)
+        shard = bad_dir / manifest.shards[0].file
+        blob = bytearray(shard.read_bytes())
+        blob[100] ^= 0xFF
+        shard.write_bytes(bytes(blob))
+        r = RecordShardReader(str(shard))
+        with pytest.raises(RecordCorruptError):
+            for i in range(len(r)):
+                r.read(i)
+
+
+class TestResumeParity:
+    def test_seek_resume_matches_fresh_sequence(self, packed):
+        """batches(start_epoch=, start_offset=) over records reproduces
+        the exact tail of an uninterrupted run — the sidecar's resume."""
+        _, records_dir, _ = packed
+        mk = lambda: RecordLoader(records_dir, 4, seed=11, num_workers=2)
+        fresh = mk()
+        it = fresh.batches()
+        full = [next(it) for _ in range(7)]  # epoch = 3 batches: crosses
+        positions = list(fresh.positions)
+        it.close()
+
+        resumed = mk()
+        epoch, offset = positions[4]
+        it = resumed.batches(start_epoch=epoch, start_offset=offset)
+        for want in full[4:]:
+            got = next(it)
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k])
+        it.close()
+
+
+class TestGlobalShuffleContract:
+    def test_pure_function_of_seed_epoch(self):
+        p1 = epoch_permutation(123, 4, 17)
+        p2 = epoch_permutation(123, 4, 17)
+        np.testing.assert_array_equal(p1, p2)
+        assert not np.array_equal(p1, epoch_permutation(123, 5, 17))
+        assert not np.array_equal(p1, epoch_permutation(124, 4, 17))
+        assert sorted(p1.tolist()) == list(range(17))
+
+    def test_stable_across_process_restart(self):
+        """The multi-host + exact-resume keystone: a RESTARTED process
+        (fresh interpreter, no shared state) derives the identical
+        permutation from (seed, epoch)."""
+        code = ("from dexiraft_tpu.data.loader import epoch_permutation;"
+                "print(epoch_permutation(123, 4, 17).tolist())")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120,
+                             cwd=osp.dirname(osp.dirname(
+                                 osp.abspath(__file__))))
+        assert out.returncode == 0, out.stderr
+        child = json.loads(out.stdout.strip())
+        assert child == epoch_permutation(123, 4, 17).tolist()
+
+    def test_two_host_slices_disjoint_and_exhaustive(self, packed):
+        """Each epoch's global batches partition into per-host slices
+        that are disjoint and together cover the usable prefix — the
+        property multi-host feeding AND exact resume both lean on."""
+        _, records_dir, _ = packed
+        rds = open_records(records_dir, augment=False)
+        n, global_batch = len(rds), 4
+        order = epoch_permutation(11, 0, n)
+        usable = len(order) // global_batch * global_batch
+
+        hosts = [RecordLoader(records_dir, global_batch, seed=11,
+                              process_index=h, process_count=2,
+                              num_workers=1) for h in (0, 1)]
+        # replicate submit_loop's slicing arithmetic per host
+        seen = []
+        for h, loader in enumerate(hosts):
+            assert loader.local_batch == 2
+            for b0 in range(0, usable, global_batch):
+                lo = b0 + h * loader.local_batch
+                seen.append(order[lo:lo + loader.local_batch])
+        flat = np.concatenate(seen)
+        assert len(flat) == usable == len(np.unique(flat))
+        assert set(flat.tolist()) == set(order[:usable].tolist())
+
+        # and through the real loaders: the two hosts' first global
+        # batch halves are disjoint sample sets drawn from that order
+        batches = []
+        for loader in hosts:
+            it = loader.batches()
+            batches.append(next(it))
+            it.close()
+        assert not np.array_equal(batches[0]["image1"],
+                                  batches[1]["image1"])
+
+
+class TestLoaderKindSidecar:
+    def test_mismatch_refused_with_actionable_error(self, tmp_path):
+        save_position(str(tmp_path), 10, StreamPosition(2, 5), seed=1,
+                      loader_kind="raw")
+        with pytest.raises(LoaderKindMismatch) as exc:
+            load_position(str(tmp_path), 10, seed=1, loader_kind="records")
+        msg = str(exc.value)
+        assert "'raw'" in msg and "'records'" in msg
+        assert "--records_dir" in msg  # actionable
+
+        save_position(str(tmp_path), 20, StreamPosition(0, 1), seed=1,
+                      loader_kind="records")
+        with pytest.raises(LoaderKindMismatch):
+            load_position(str(tmp_path), 20, seed=1, loader_kind="raw")
+
+    def test_pack_fingerprint_mismatch_refused(self, tmp_path):
+        """records -> DIFFERENT records pack (repack, other mixture or
+        crop recipe) is refused too — loader_kind alone can't tell."""
+        save_position(str(tmp_path), 10, StreamPosition(1, 3), seed=1,
+                      loader_kind="records", fingerprint="a" * 40)
+        with pytest.raises(LoaderKindMismatch) as exc:
+            load_position(str(tmp_path), 10, seed=1,
+                          loader_kind="records", fingerprint="b" * 40)
+        assert "fingerprint" in str(exc.value)
+        # the original pack resumes
+        assert load_position(str(tmp_path), 10, seed=1,
+                             loader_kind="records",
+                             fingerprint="a" * 40) == StreamPosition(1, 3)
+
+    def test_crop_recipe_changes_fingerprint(self, packed, tmp_path):
+        """Two packs of the same tree at different crop recipes must
+        fingerprint differently (the sidecar check depends on it)."""
+        ds, _, manifest = packed
+        import copy
+
+        other = copy.copy(ds)
+        other.augmentor = type(ds.augmentor)(
+            crop_size=(32, 48), min_scale=-0.1, max_scale=1.0,
+            do_flip=True)
+        m2 = pack_dataset(other, str(tmp_path / "repack"), num_shards=1)
+        assert m2.fingerprint != manifest.fingerprint
+
+    def test_matching_and_legacy_sidecars_resume(self, tmp_path):
+        save_position(str(tmp_path), 10, StreamPosition(2, 5), seed=1,
+                      loader_kind="records")
+        pos = load_position(str(tmp_path), 10, seed=1,
+                            loader_kind="records")
+        assert pos == StreamPosition(2, 5)
+        # pre-records sidecar (no loader_kind field): resumes either way
+        save_position(str(tmp_path), 30, StreamPosition(1, 2), seed=1)
+        assert load_position(str(tmp_path), 30, seed=1,
+                             loader_kind="records") == StreamPosition(1, 2)
+        assert load_position(str(tmp_path), 30, seed=1,
+                             loader_kind="raw") == StreamPosition(1, 2)
+
+
+class TestRawRecordsLoaderParity:
+    def test_identical_batch_stream(self, packed):
+        """The pack->train parity the acceptance pins at loader level:
+        raw Loader and RecordLoader over the same logical dataset yield
+        the identical batch sequence, including a mid-epoch resume."""
+        ds, records_dir, _ = packed
+        raw = Loader(2 * ds, 4, seed=5, num_workers=2)
+        rec = RecordLoader(records_dir, 4, seed=5, num_workers=2)
+        it_raw, it_rec = raw.batches(), rec.batches()
+        try:
+            for _ in range(4):
+                a, b = next(it_raw), next(it_rec)
+                for k in a:
+                    np.testing.assert_array_equal(a[k], b[k])
+        finally:
+            it_raw.close()
+            it_rec.close()
+
+        # mid-epoch resume on BOTH planes lands on the same batch
+        it_raw = raw.batches(start_epoch=1, start_offset=1)
+        it_rec = rec.batches(start_epoch=1, start_offset=1)
+        try:
+            a, b = next(it_raw), next(it_rec)
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+        finally:
+            it_raw.close()
+            it_rec.close()
+
+
+class TestBenchSchema:
+    def test_records_ab_keys_pinned(self):
+        """loader_bench --records writes the comparison record with the
+        pinned schema (no subprocess: just the constant's contract)."""
+        sys.path.insert(0, osp.join(osp.dirname(osp.dirname(
+            osp.abspath(__file__))), "scripts"))
+        try:
+            import loader_bench
+        finally:
+            sys.path.pop(0)
+        assert loader_bench.RECORDS_AB_KEYS[0] == "metric"
+        assert "samples_per_sec_speedup" in loader_bench.RECORDS_AB_KEYS
+        assert "resume_latency_speedup" in loader_bench.RECORDS_AB_KEYS
+        assert "resume_latency_s" in loader_bench.RECORDS_SIDE_KEYS
