@@ -27,6 +27,44 @@ import time
 
 BASELINE_ITERS_PER_SEC = 320.0
 ITERS = 32
+
+# ---- record schema pin (tests/test_bench_watchdog.py) -------------------
+# Top-level keys every bench record MUST carry. The per-config diagnostic
+# keys are prefixed (e.g. "allpairs_raw_ms", "fused_pallas_int8_mfu") and
+# open-ended; the conditional keys below appear only in the situations
+# their comments in main() describe.
+BENCH_RECORD_KEYS = frozenset({
+    "metric", "value", "unit", "vs_baseline", "platform", "fallback",
+    "baseline_kind", "baseline_iters_per_sec", "device_kind", "iters",
+    "corr_impl", "corr_dtype", "fused_update", "dexined_upconv",
+    "loop_only_iters_per_sec", "loop_only_vs_whole_forward_baseline",
+    "allpairs_iters_per_sec", "local_corr_iters_per_sec",
+    "pallas_corr_iters_per_sec",
+})
+BENCH_RECORD_OPTIONAL_KEYS = frozenset({
+    "cpu_anchor_flax_over_torch", "cpu_anchor_flax_over_torch_train",
+    "cpu_anchor_source", "builder_tpu_reference", "forward_flops", "mfu",
+    "chip_peak_bf16_flops",
+})
+# every sweep leg's diagnostics land under its tag prefix
+BENCH_DIAG_PREFIXES = (
+    "allpairs", "local", "pallas", "fused_pallas",
+)
+
+
+def validate_record(rec: dict) -> None:
+    """Schema gate for the ONE JSON line the driver greps: all required
+    keys present; nothing outside required + optional + tag-prefixed
+    diagnostics. Raises ValueError so a drifted record fails the run
+    instead of silently changing shape under the queue tooling."""
+    missing = BENCH_RECORD_KEYS - set(rec)
+    if missing:
+        raise ValueError(f"bench record missing keys: {sorted(missing)}")
+    for key in set(rec) - BENCH_RECORD_KEYS - BENCH_RECORD_OPTIONAL_KEYS:
+        if not any(key.startswith(p + "_") for p in BENCH_DIAG_PREFIXES):
+            raise ValueError(f"bench record carries unpinned key {key!r}; "
+                             "extend BENCH_RECORD_KEYS (and the schema "
+                             "test) deliberately, not by accident")
 HEIGHT, WIDTH = 440, 1024  # 436 padded to /8 (core/utils/utils.py:7-19)
 # CPU fallback: the number is diagnostic only (smoke proof the model
 # runs), so spend seconds, not minutes, producing it
@@ -327,7 +365,12 @@ def main() -> None:
     image2 = jax.random.uniform(k2, (1, height, width, 3), jnp.float32, 0, 255)
 
     trivial = jax.jit(lambda x: jnp.sum(x))
-    float(trivial(jnp.ones((8, 8))))  # compile once, outside any timing
+    # ONE device-resident probe operand: creating it inside a strict
+    # window would be an implicit host->device constant transfer
+    probe = jax.device_put(jnp.ones((8, 8)))
+    float(jax.device_get(trivial(probe)))  # compile once, outside timing
+
+    from dexiraft_tpu.analysis import guards
 
     def measure_rtt(reps: int = 4) -> float:
         # each sync fetch costs one tunnel round-trip (~65-140 ms and it
@@ -336,13 +379,15 @@ def main() -> None:
         # tunnel's current latency
         t0 = time.perf_counter()
         for _ in range(reps):
-            float(trivial(jnp.ones((8, 8))))
+            float(jax.device_get(trivial(probe)))
         return (time.perf_counter() - t0) / reps
 
     def measure(corr_impl: str, upconv: str = "subpixel",
-                measure_loop: bool = True):
+                measure_loop: bool = True, corr_dtype: str = "fp32",
+                fused: bool = False):
         cfg = raft_v5(mixed_precision=on_tpu, corr_impl=corr_impl,
-                      dexined_upconv=upconv)
+                      dexined_upconv=upconv, corr_dtype=corr_dtype,
+                      fused_update=fused)
         model = RAFT(cfg)
         init = jax.jit(
             lambda r, a, b: model.init(r, a, b, iters=1, train=False))
@@ -362,17 +407,25 @@ def main() -> None:
             return forward
 
         def timed_block(fn, reps):
-            """Mean wall time of float(fn(...)) plus the RTT floor
+            """Mean wall time of the synced forward plus the RTT floor
             measured IMMEDIATELY before and after the block (the tunnel
             latency drifts; a stale floor can shift the corrected number
-            by 10-25%). Returns (raw_s, rtt_s)."""
-            float(fn(image1, image2))  # compile + warmup
-            rtt_pre = measure_rtt()
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                float(fn(image1, image2))
-            raw = (time.perf_counter() - t0) / reps
-            rtt_post = measure_rtt()
+            by 10-25%). Returns (raw_s, rtt_s).
+
+            The timed region runs under guards.strict_mode (the PR 5
+            steady-state contract, same as train_bench/serve_bench): the
+            warmup call above it absorbs the one expected compile, so
+            any retrace or implicit host<->device transfer inside the
+            window FAILS the bench instead of deflating the number. The
+            sync is an explicit device_get — the sanctioned spelling."""
+            float(jax.device_get(fn(image1, image2)))  # compile + warmup
+            with guards.strict_mode(label="bench:steady"):
+                rtt_pre = measure_rtt()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    float(jax.device_get(fn(image1, image2)))
+                raw = (time.perf_counter() - t0) / reps
+                rtt_post = measure_rtt()
             return raw, (rtt_pre + rtt_post) / 2
 
         def rtt_corrected(dt, rtt):
@@ -401,7 +454,7 @@ def main() -> None:
             t0 = time.perf_counter()
             for _ in range(k):
                 out = fn(image1, image2)
-            float(out)
+            float(jax.device_get(out))
             return time.perf_counter() - t0
 
         def slope_time(fn, k=7, rounds=2):
@@ -413,12 +466,15 @@ def main() -> None:
             between adjacent probes. min over rounds: wall-clock noise
             is one-sided additive."""
             best = None
-            for _ in range(rounds):
-                t1 = pipeline_time(fn, 1)
-                tk = pipeline_time(fn, k)
-                s = (tk - t1) / (k - 1)
-                if s > 0 and (best is None or s < best):
-                    best = s
+            # fn is warm by the time the slope runs (timed_block
+            # precedes it), so the slope window is compile-flat too
+            with guards.strict_mode(label="bench:slope"):
+                for _ in range(rounds):
+                    t1 = pipeline_time(fn, 1)
+                    tk = pipeline_time(fn, k)
+                    s = (tk - t1) / (k - 1)
+                    if s > 0 and (best is None or s < best):
+                        best = s
             return best
 
         reps = 3 if on_tpu else 1
@@ -501,7 +557,9 @@ def main() -> None:
     # rate of their subpixel sibling on the same corr path.
     allpairs_ips, allpairs_loop, ap_diag = measure("allpairs", "subpixel")
     diag = {f"allpairs_{k}": v for k, v in ap_diag.items()}
-    candidates = [("allpairs", "subpixel", allpairs_ips, allpairs_loop)]
+    # candidate = (corr_impl, upconv, corr_dtype, fused, ips, loop_ips)
+    candidates = [("allpairs", "subpixel", "fp32", False,
+                   allpairs_ips, allpairs_loop)]
     loop_by_corr = {"allpairs": allpairs_loop}
     # the parent kills us at HARD_CAP_S with the record unprinted — if
     # the sweep is running long (slow relay compiles), drop remaining
@@ -512,12 +570,21 @@ def main() -> None:
     if on_tpu:  # secondary metrics; not worth CPU-fallback time.
         # pallas is on-tpu-only by the same guard: on CPU the kernel
         # runs in interpreter mode — minutes per forward at full
-        # geometry, with nothing to learn from the timing
-        for corr_impl, upconv, tag in (
-                ("local", "subpixel", "local"),
-                ("pallas", "subpixel", "pallas"),
-                ("allpairs", "transpose", "allpairs_transpose"),
-                ("local", "transpose", "local_transpose")):
+        # geometry, with nothing to learn from the timing. The sweep
+        # stays best-known-first; the quantized-pyramid and fused-step
+        # legs (this PR's A/B — ISSUE 8) run after the established
+        # orderings so a mid-sweep relay death still leaves the
+        # headline config measured.
+        for corr_impl, upconv, corr_dtype, fused, tag in (
+                ("local", "subpixel", "fp32", False, "local"),
+                ("pallas", "subpixel", "fp32", False, "pallas"),
+                ("allpairs", "subpixel", "bf16", False, "allpairs_bf16"),
+                ("allpairs", "subpixel", "int8", False, "allpairs_int8"),
+                ("pallas", "subpixel", "fp32", True, "fused_pallas"),
+                ("pallas", "subpixel", "int8", True, "fused_pallas_int8"),
+                ("allpairs", "transpose", "fp32", False,
+                 "allpairs_transpose"),
+                ("local", "transpose", "fp32", False, "local_transpose")):
             if time.perf_counter() - _T0 > secondary_budget_s:
                 _log(f"[{tag}] skipped: over secondary budget "
                      f"({secondary_budget_s:.0f}s)")
@@ -525,26 +592,33 @@ def main() -> None:
             try:
                 with_loop = upconv == "subpixel"
                 ips, loop, d = measure(corr_impl, upconv,
-                                       measure_loop=with_loop)
+                                       measure_loop=with_loop,
+                                       corr_dtype=corr_dtype, fused=fused)
                 diag.update({f"{tag}_{k}": v for k, v in d.items()})
                 diag[f"{tag}_iters_per_sec"] = round(ips, 2)
-                if loop is not None:
+                if loop is not None and corr_dtype == "fp32" and not fused:
                     loop_by_corr[corr_impl] = loop
                 candidates.append(
-                    (corr_impl, upconv, ips,
+                    (corr_impl, upconv, corr_dtype, fused, ips,
                      loop if loop is not None else loop_by_corr.get(corr_impl)))
             except Exception as e:  # never lose the primary number
                 _log(f"[{tag}] failed: {e}")
 
-    impl, upconv_best, iters_per_sec, loop_ips = max(
-        candidates, key=lambda c: c[2])
+    impl, upconv_best, dtype_best, fused_best, iters_per_sec, loop_ips = max(
+        candidates, key=lambda c: c[4])
     local_ips = diag.get("local_iters_per_sec")
 
     # MFU of the winning config: counted whole-forward FLOPs (XLA cost
     # analysis of the compiled executable) / measured forward time /
     # chip bf16 peak. Reported only when both the FLOP count and a
     # known chip peak exist; the record names both inputs.
-    win_tag = impl if upconv_best == "subpixel" else f"{impl}_transpose"
+    if fused_best:
+        win_tag = "fused_pallas" + ("" if dtype_best == "fp32"
+                                    else f"_{dtype_best}")
+    elif dtype_best != "fp32":
+        win_tag = f"{impl}_{dtype_best}"
+    else:
+        win_tag = impl if upconv_best == "subpixel" else f"{impl}_transpose"
     win_flops = diag.get(f"{win_tag}_forward_flops")
     device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
     peak = CHIP_PEAK_BF16_FLOPS.get(device_kind)
@@ -558,7 +632,7 @@ def main() -> None:
                 "chip_peak_bf16_flops": peak,
             })
 
-    print(json.dumps({
+    rec = {
         "metric": f"refinement_iters_per_sec_per_chip@{height}x{width}",
         "value": round(iters_per_sec, 2),
         "unit": "iters/s",
@@ -606,6 +680,11 @@ def main() -> None:
         **mfu_fields,
         "iters": iters,
         "corr_impl": impl,
+        # the winning config's pyramid storage precision and fused-step
+        # flag (ISSUE 8): together with corr_impl/dexined_upconv these
+        # four keys fully name the headline configuration
+        "corr_dtype": dtype_best,
+        "fused_update": fused_best,
         "dexined_upconv": upconv_best,
         "loop_only_iters_per_sec": (round(loop_ips, 2) if loop_ips
                                     else None),
@@ -620,11 +699,13 @@ def main() -> None:
         "local_corr_iters_per_sec": local_ips,
         "pallas_corr_iters_per_sec": diag.get("pallas_iters_per_sec"),
         **diag,
-        # flush: stdout is a block-buffered pipe under the watchdog
-        # parent; if JAX teardown hangs after this point (observed with
-        # a dead relay), an unflushed record would die in the buffer and
-        # the parent would discard a completed measurement
-    }), flush=True)
+    }
+    validate_record(rec)  # schema pin — a drifted record fails loudly
+    # flush: stdout is a block-buffered pipe under the watchdog
+    # parent; if JAX teardown hangs after this point (observed with
+    # a dead relay), an unflushed record would die in the buffer and
+    # the parent would discard a completed measurement
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

@@ -10,6 +10,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+# storage precisions for the correlation volume / fmap2 pyramid
+# (ops/quant.py implements them; lives here jax-free so CLI parser
+# construction — including `serve --help` — doesn't pay the jax import).
+# int8 is an inference format: its round() kills fmap gradients, so the
+# model refuses to train with it (models/raft.py).
+CORR_DTYPES = ("fp32", "bf16", "int8")
+
 
 @dataclasses.dataclass(frozen=True)
 class RAFTConfig:
@@ -33,6 +40,22 @@ class RAFTConfig:
     dropout: float = 0.0
     mixed_precision: bool = False  # bf16 compute in encoders/update; corr stays fp32
     corr_impl: str = "allpairs"  # allpairs | local | pallas (on-demand paths)
+    # STORAGE precision of the correlation pyramid (allpairs: the
+    # materialized volume levels; local/pallas: the fmap2 pyramid the
+    # lookup streams) — "fp32" | "bf16" | "int8" (per-level scale,
+    # dequantized inside the consuming matmul/kernel, ops/quant.py).
+    # Correlation math stays fp32-accumulated on every path; this knob
+    # only changes the HBM bytes each refinement iteration moves. int8
+    # is inference-only (gradients to the quantized operand are dead)
+    corr_dtype: str = "fp32"
+    # fuse each refinement iteration's 4-level window lookup WITH the
+    # motion encoder's 1x1 corr conv into ONE Pallas kernel
+    # (ops/pallas_corr.pallas_fused_step): the (2r+1)^2-per-level corr
+    # features never round-trip HBM — only the conv's F-channel output
+    # does. Requires corr_impl="pallas" (the VMEM-kernel formulation);
+    # parameter tree is IDENTICAL to the unfused path, so checkpoints
+    # interchange (models/update.py FusedCorrEncoder)
+    fused_update: bool = False
     # rows per chunk for the local path's gather (bounds the transient
     # patch buffer to rows*W*(2r+2)^2*C floats; None = whole frame at once)
     corr_row_chunk: Optional[int] = 8

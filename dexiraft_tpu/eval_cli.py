@@ -36,6 +36,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["allpairs", "local", "pallas"],
                    help="'local'/'pallas' = the memory-efficient on-demand "
                         "path (the reference's --alternate_corr)")
+    p.add_argument("--corr_dtype", default="fp32",
+                   choices=["fp32", "bf16", "int8"],
+                   help="storage precision of the correlation pyramid "
+                        "(bf16 halves / int8 quarters the refinement "
+                        "loop's HBM traffic; docs/perf.md has the "
+                        "accuracy bounds)")
+    p.add_argument("--fused_update", action="store_true",
+                   help="fuse lookup + motion-encoder corr conv into one "
+                        "Pallas kernel per iteration (requires "
+                        "--corr_impl pallas; same checkpoints)")
     p.add_argument("--scan_unroll", type=int, default=1,
                    help="refinement-scan unroll factor (XLA pipelining "
                         "knob; numerically identical)")
@@ -82,9 +92,13 @@ def load_variables(args):
         ckpt.require_checkpoints(args.model)
     except FileNotFoundError as e:
         raise SystemExit(f"eval: {e}")
+    if args.fused_update and args.corr_impl != "pallas":
+        raise SystemExit("eval: --fused_update requires --corr_impl pallas")
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
                                  corr_impl=args.corr_impl,
+                                 corr_dtype=args.corr_dtype,
+                                 fused_update=args.fused_update,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())

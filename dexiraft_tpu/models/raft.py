@@ -79,20 +79,32 @@ class RAFTStep(nn.Module):
         coords0 = coords_grid(b, pyr.ht, pyr.wd)
 
         coords1 = jax.lax.stop_gradient(carry["coords1"])  # (2B or B, h, w, 2)
-        if cfg.remat_lookup and not cfg.remat:
-            # recompute the lookup in backward instead of storing its
-            # intermediates (the per-iteration hat matrices dominate
-            # training memory — config.py remat_lookup). The pyramid is
-            # passed as an argument so its gradients flow normally;
-            # prevent_cse=False matches the full-remat scan convention
-            # (the scan already rules out the CSE hazard)
-            corr = jax.checkpoint(lambda p, c: p(c),
-                                  prevent_cse=False)(pyr, coords1)
-        else:
-            corr = pyr(coords1)
         flow = coords1 - jnp.concatenate([coords0, coords0], 0) if dual \
             else coords1 - coords0
-        net, up_mask, delta = update_block(carry["net"], consts["inp"], corr, flow)
+        if cfg.fused_update:
+            # fused step (config.fused_update): the lookup and the motion
+            # encoder's 1x1 corr conv run in ONE Pallas kernel inside the
+            # update block — the (B, H, W, L*win^2) corr features never
+            # materialize in HBM, which also makes remat_lookup moot here
+            # (the fused VJP recomputes through the XLA reference anyway)
+            net, up_mask, delta = update_block(
+                carry["net"], consts["inp"], None, flow,
+                pyr=pyr, coords=coords1)
+        else:
+            if cfg.remat_lookup and not cfg.remat:
+                # recompute the lookup in backward instead of storing its
+                # intermediates (the per-iteration hat matrices dominate
+                # training memory — config.py remat_lookup). The pyramid
+                # is passed as an argument so its gradients flow
+                # normally; prevent_cse=False matches the full-remat
+                # scan convention (the scan already rules out the CSE
+                # hazard)
+                corr = jax.checkpoint(lambda p, c: p(c),
+                                      prevent_cse=False)(pyr, coords1)
+            else:
+                corr = pyr(coords1)
+            net, up_mask, delta = update_block(carry["net"], consts["inp"],
+                                               corr, flow)
         delta = delta.astype(jnp.float32)
 
         if dual:
@@ -168,6 +180,22 @@ class RAFT(nn.Module):
         cfg = self.cfg
         if cfg.corr_impl not in ("allpairs", "local", "pallas"):
             raise ValueError(f"unknown corr_impl {cfg.corr_impl!r}")
+        from dexiraft_tpu.config import CORR_DTYPES
+
+        if cfg.corr_dtype not in CORR_DTYPES:
+            raise ValueError(f"unknown corr_dtype {cfg.corr_dtype!r}; "
+                             f"expected one of {CORR_DTYPES}")
+        if cfg.fused_update and cfg.corr_impl != "pallas":
+            raise ValueError(
+                "fused_update=True requires corr_impl='pallas' (the fused "
+                "step kernel is the VMEM lookup formulation; the allpairs "
+                "volume cannot be tiled per pixel block)")
+        if train and cfg.corr_dtype == "int8":
+            raise ValueError(
+                "corr_dtype='int8' is an inference format: the round() in "
+                "quantization zeroes the fmap gradients, which would train "
+                "the feature encoder silently dead. Use 'bf16' (or 'fp32') "
+                "for training and 'int8' for eval/serve")
         if cfg.variant == "dual" and not cfg.embed_dexined:
             raise ValueError(
                 "variant='dual' requires embed_dexined=True (the v5 edge "
@@ -217,12 +245,16 @@ class RAFT(nn.Module):
 
         def build_pyr(f1, f2):
             # plugin seam (BASELINE.json): materialized MXU volume vs
-            # on-demand local correlation (the alt_cuda_corr analog)
+            # on-demand local correlation (the alt_cuda_corr analog);
+            # corr_dtype sets the pyramid's STORAGE precision on both
+            # (ops/quant.py — dequantized inside the lookup)
             if cfg.corr_impl == "allpairs":
-                return build_corr_pyramid(f1, f2, cfg.corr_levels, cfg.radius)
+                return build_corr_pyramid(f1, f2, cfg.corr_levels, cfg.radius,
+                                          dtype=cfg.corr_dtype)
             return build_local_corr(f1, f2, cfg.corr_levels, cfg.radius,
                                     row_chunk=cfg.corr_row_chunk,
-                                    use_pallas=cfg.corr_impl == "pallas")
+                                    use_pallas=cfg.corr_impl == "pallas",
+                                    dtype=cfg.corr_dtype)
 
         fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
                             train=train, bn_train=bn_train)

@@ -4,6 +4,18 @@ Flax re-design of the reference update blocks (core/update.py) plus the
 corrected RefineFlow fusion head from the v3 variant (core/update_3.py:138-151
 — the reference's version outputs 1 channel where flow needs 2, which made
 v3 diverge; ours outputs 2 and documents the deviation).
+
+The motion encoders own the fused refinement-step seam
+(config.fused_update): their first layer — the 1x1 conv over the
+(2r+1)^2-per-level correlation features — is exactly a per-pixel matmul,
+so it can run INSIDE the Pallas lookup kernel while each pixel block's
+correlation window is still VMEM-resident (ops/pallas_corr.py
+pallas_fused_step). ``FusedCorrEncoder`` declares parameters with the
+same names/shapes/initializers as the ``nn.Conv`` it replaces, under the
+same module name ("Conv_0"), so the parameter tree — and therefore every
+checkpoint and the torch interop name map (interop/torch_convert.py) —
+is identical with and without fusion. The convs are explicitly named
+with the auto-names they have always had, pinning that contract.
 """
 
 from __future__ import annotations
@@ -77,21 +89,73 @@ class SepConvGRU(nn.Module):
         return h
 
 
+class FusedCorrEncoder(nn.Module):
+    """The motion encoder's 1x1 corr conv, executed INSIDE the fused
+    Pallas lookup kernel (pre-activation; the relu stays in XLA).
+
+    Declares ``kernel``/``bias`` with ``nn.Conv``'s exact shapes and
+    initializers, so instantiating it under the name the conv would have
+    had ("Conv_0") keeps the parameter tree bit-identical to the unfused
+    path — the same checkpoint serves both, which is what makes the
+    fused/unfused A/B (and the parity tests) meaningful.
+
+    Per-level int8 dequantization scales are linear, so they are folded
+    into the weight's per-level row blocks here, in XLA, before the
+    kernel sees them — the kernel reads the pyramid in its storage
+    dtype. (The 1/sqrt(C) correlation normalization is NOT folded: the
+    kernel applies it itself, like every other corr path.)
+    """
+
+    features: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pyr, coords):
+        from dexiraft_tpu.ops.pallas_corr import pallas_fused_step
+
+        num_levels = len(pyr.fmap2_pyramid)
+        win = 2 * pyr.radius + 1
+        in_ch = num_levels * win * win
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (1, 1, in_ch, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        w = kernel.reshape(in_ch, self.features).astype(jnp.float32)
+        if pyr.scales is not None:
+            ww = win * win
+            w = jnp.concatenate(
+                [w[lvl * ww:(lvl + 1) * ww] * pyr.scales[lvl]
+                 for lvl in range(num_levels)], axis=0)
+        out = pallas_fused_step(pyr.fmap1, pyr.fmap2_pyramid, coords,
+                                w, bias.astype(jnp.float32), pyr.radius,
+                                None, pyr.row_chunk)
+        return out.astype(self.dtype)
+
+
 class SmallMotionEncoder(nn.Module):
     """Embed (corr, flow) -> 82-channel motion features.
 
-    Reference: core/update.py:62-77.
+    Reference: core/update.py:62-77. ``pyr``/``coords`` select the fused
+    path: the Conv_0 lookup-conv runs inside the Pallas kernel and
+    ``corr`` is never materialized (pass corr=None there).
     """
 
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, flow, corr):
-        cor = nn.relu(nn.Conv(96, (1, 1), dtype=self.dtype)(corr))
-        flo = nn.relu(nn.Conv(64, (7, 7), padding=3, dtype=self.dtype)(flow))
-        flo = nn.relu(nn.Conv(32, (3, 3), padding=1, dtype=self.dtype)(flo))
+    def __call__(self, flow, corr, pyr=None, coords=None):
+        if pyr is not None:
+            cor = nn.relu(FusedCorrEncoder(96, self.dtype,
+                                           name="Conv_0")(pyr, coords))
+        else:
+            cor = nn.relu(nn.Conv(96, (1, 1), dtype=self.dtype,
+                                  name="Conv_0")(corr))
+        flo = nn.relu(nn.Conv(64, (7, 7), padding=3, dtype=self.dtype,
+                              name="Conv_1")(flow))
+        flo = nn.relu(nn.Conv(32, (3, 3), padding=1, dtype=self.dtype,
+                              name="Conv_2")(flo))
         out = nn.relu(
-            nn.Conv(80, (3, 3), padding=1, dtype=self.dtype)(
+            nn.Conv(80, (3, 3), padding=1, dtype=self.dtype, name="Conv_3")(
                 jnp.concatenate([cor, flo], axis=-1)
             )
         )
@@ -101,19 +165,29 @@ class SmallMotionEncoder(nn.Module):
 class BasicMotionEncoder(nn.Module):
     """Embed (corr, flow) -> 128-channel motion features.
 
-    Reference: core/update.py:79-97.
+    Reference: core/update.py:79-97. ``pyr``/``coords`` select the fused
+    path (see SmallMotionEncoder).
     """
 
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, flow, corr):
-        cor = nn.relu(nn.Conv(256, (1, 1), dtype=self.dtype)(corr))
-        cor = nn.relu(nn.Conv(192, (3, 3), padding=1, dtype=self.dtype)(cor))
-        flo = nn.relu(nn.Conv(128, (7, 7), padding=3, dtype=self.dtype)(flow))
-        flo = nn.relu(nn.Conv(64, (3, 3), padding=1, dtype=self.dtype)(flo))
+    def __call__(self, flow, corr, pyr=None, coords=None):
+        if pyr is not None:
+            cor = nn.relu(FusedCorrEncoder(256, self.dtype,
+                                           name="Conv_0")(pyr, coords))
+        else:
+            cor = nn.relu(nn.Conv(256, (1, 1), dtype=self.dtype,
+                                  name="Conv_0")(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3), padding=1, dtype=self.dtype,
+                              name="Conv_1")(cor))
+        flo = nn.relu(nn.Conv(128, (7, 7), padding=3, dtype=self.dtype,
+                              name="Conv_2")(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3), padding=1, dtype=self.dtype,
+                              name="Conv_3")(flo))
         out = nn.relu(
-            nn.Conv(128 - 2, (3, 3), padding=1, dtype=self.dtype)(
+            nn.Conv(128 - 2, (3, 3), padding=1, dtype=self.dtype,
+                    name="Conv_4")(
                 jnp.concatenate([cor, flo], axis=-1)
             )
         )
@@ -130,8 +204,9 @@ class SmallUpdateBlock(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, net, inp, corr, flow):
-        motion = SmallMotionEncoder(self.dtype)(flow, corr)
+    def __call__(self, net, inp, corr, flow, pyr=None, coords=None):
+        motion = SmallMotionEncoder(self.dtype)(flow, corr,
+                                                pyr=pyr, coords=coords)
         net = ConvGRU(self.hidden_dim, self.dtype)(net, jnp.concatenate([inp, motion], axis=-1))
         delta_flow = FlowHead(128, self.dtype)(net)
         return net, None, delta_flow
@@ -148,8 +223,9 @@ class BasicUpdateBlock(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, net, inp, corr, flow):
-        motion = BasicMotionEncoder(self.dtype)(flow, corr)
+    def __call__(self, net, inp, corr, flow, pyr=None, coords=None):
+        motion = BasicMotionEncoder(self.dtype)(flow, corr,
+                                                pyr=pyr, coords=coords)
         net = SepConvGRU(self.hidden_dim, self.dtype)(net, jnp.concatenate([inp, motion], axis=-1))
         delta_flow = FlowHead(256, self.dtype)(net)
 

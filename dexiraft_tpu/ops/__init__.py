@@ -16,10 +16,22 @@ from dexiraft_tpu.ops.corr import (
     corr_lookup,
     CorrPyramid,
 )
+from dexiraft_tpu.ops.quant import (
+    CORR_DTYPES,
+    corr_dtype_bytes,
+    dequantize,
+    quantize_symmetric,
+    store_corr,
+)
 from dexiraft_tpu.ops.upsample import upsample_flow_convex
 from dexiraft_tpu.ops.losses import sequence_loss, flow_metrics
 
 __all__ = [
+    "CORR_DTYPES",
+    "corr_dtype_bytes",
+    "dequantize",
+    "quantize_symmetric",
+    "store_corr",
     "bilinear_sampler",
     "coords_grid",
     "resize_bilinear_align_corners",

@@ -18,27 +18,33 @@ equivalent of the reference's alt_cuda_corr CUDA kernel
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 import flax.struct
 import jax
 import jax.numpy as jnp
+
+from dexiraft_tpu.ops.quant import store_corr
 
 
 @flax.struct.dataclass
 class CorrPyramid:
     """Correlation pyramid + lookup geometry.
 
-    A pytree whose leaves are only the level arrays; the geometry ints are
-    static aux data, so instances are safe to pass through jit boundaries
-    and lax.scan carries without tracer leakage into shape arithmetic.
+    A pytree whose leaves are only the level arrays (and the per-level
+    quantization scales, when present); the geometry ints are static aux
+    data, so instances are safe to pass through jit boundaries and
+    lax.scan carries without tracer leakage into shape arithmetic.
     """
 
-    levels: tuple  # tuple of (B*H*W, H_l, W_l, 1) arrays
+    levels: tuple  # tuple of (B*H*W, H_l, W_l, 1) arrays (fp32/bf16/int8)
     batch: int = flax.struct.field(pytree_node=False)
     ht: int = flax.struct.field(pytree_node=False)
     wd: int = flax.struct.field(pytree_node=False)
     radius: int = flax.struct.field(pytree_node=False)
+    # per-level fp32 scalar dequantization scales for int8 storage; None
+    # for the scale-free dtypes (ops/quant.py). A pytree leaf tuple.
+    scales: Optional[tuple] = None
 
     def __call__(self, coords: jax.Array) -> jax.Array:
         return corr_lookup(self, coords)
@@ -81,7 +87,8 @@ def avg_pool_2x2(x: jax.Array) -> jax.Array:
 
 
 def build_corr_pyramid(
-    fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4, radius: int = 4
+    fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4, radius: int = 4,
+    dtype: str = "fp32",
 ) -> CorrPyramid:
     """Materialize the all-pairs volume and its average-pool pyramid.
 
@@ -94,14 +101,26 @@ def build_corr_pyramid(
     mean), but each level is then a direct MXU matmul instead of strided
     2x2 pooling passes over the ~200 MB level-0 volume, which on TPU cost
     more than the matmul itself.
+
+    ``dtype`` is the STORAGE precision of the pyramid ("fp32", "bf16",
+    "int8" — ops/quant.py): correlation is always computed fp32, then
+    each level is stored low-precision (per-level scale for int8) and
+    dequantized inside the lookup's matmuls. This halves/quarters the
+    HBM bytes every refinement iteration streams — the loop's bandwidth
+    term (docs/perf.md "Correlation memory & precision").
     """
     b, h, w, _ = fmap1.shape
     f2 = fmap2
     levels: List[jax.Array] = []
+    scales: List[Optional[jax.Array]] = []
     for _ in range(num_levels):
-        levels.append(all_pairs_correlation(fmap1, f2))
+        lvl, scale = store_corr(all_pairs_correlation(fmap1, f2), dtype)
+        levels.append(lvl)
+        scales.append(scale)
         f2 = avg_pool_2x2(f2.astype(jnp.float32))
-    return CorrPyramid(levels=tuple(levels), batch=b, ht=h, wd=w, radius=radius)
+    return CorrPyramid(
+        levels=tuple(levels), batch=b, ht=h, wd=w, radius=radius,
+        scales=tuple(scales) if dtype == "int8" else None)
 
 
 def _window_delta(radius: int, dtype=jnp.float32) -> jax.Array:
@@ -141,10 +160,18 @@ def _axis_interp_matrix(center: jax.Array, radius: int, size: int,
     return jnp.maximum(0.0, 1.0 - jnp.abs(pos - t[..., None]))
 
 
-def interp_window(vol: jax.Array, centers: jax.Array, radius: int) -> jax.Array:
+def interp_window(vol: jax.Array, centers: jax.Array, radius: int,
+                  scale: Optional[jax.Array] = None) -> jax.Array:
     """Bilinear (2r+1)^2 window of each volume slab around its center.
 
     vol (N, Hl, Wl), centers (N, 2) in level pixels -> (N, (2r+1)^2).
+
+    ``vol`` may be stored below fp32 (bf16/int8 pyramid, ops/quant.py):
+    the upcast happens inside the einsum's operand read (XLA fuses the
+    convert into the matmul, so the fp32 values never round-trip HBM),
+    and ``scale`` — the int8 dequantization factor — multiplies the
+    window afterwards, which is exact because the whole lookup is linear
+    in the volume.
 
     TPU formulation: the taps sit at INTEGER offsets from one real-valued
     center per slab, so every tap shares the slab's fractional part and
@@ -168,8 +195,13 @@ def interp_window(vol: jax.Array, centers: jax.Array, radius: int) -> jax.Array:
     hl, wl = vol.shape[1], vol.shape[2]
     ax = _axis_interp_matrix(centers[:, 0], radius, wl)  # (N, win, Wl)
     ay = _axis_interp_matrix(centers[:, 1], radius, hl)  # (N, win, Hl)
-    window = jnp.einsum("nby,nyx,nax->nab", ay, vol, ax,
+    # upcast in the operand read (fuses into the matmul; TPU's default
+    # matmul precision truncates fp32 inputs to bf16 internally anyway —
+    # lookup_ab3's finding — so the storage dtype only changes HBM bytes)
+    window = jnp.einsum("nby,nyx,nax->nab", ay, vol.astype(jnp.float32), ax,
                         preferred_element_type=jnp.float32)
+    if scale is not None:
+        window = window * scale
     return window.reshape(vol.shape[0], win * win)
 
 
@@ -187,6 +219,7 @@ def corr_lookup(pyramid: CorrPyramid, coords: jax.Array) -> jax.Array:
     flat = coords.reshape(b * h * w, 2).astype(jnp.float32)
     out = []
     for i, corr in enumerate(pyramid.levels):
-        window = interp_window(corr[..., 0], flat / (2.0**i), r)
+        scale = pyramid.scales[i] if pyramid.scales is not None else None
+        window = interp_window(corr[..., 0], flat / (2.0**i), r, scale=scale)
         out.append(window.reshape(b, h, w, win * win))
     return jnp.concatenate(out, axis=-1).astype(jnp.float32)

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from dexiraft_tpu.ops.corr import avg_pool_2x2
+from dexiraft_tpu.ops.quant import store_corr
 
 
 def local_corr_level(
@@ -102,14 +103,23 @@ class LocalCorr:
     correlation is computed per lookup instead of materialized.
     """
 
-    fmap1: jax.Array  # (B, H, W, C)
-    fmap2_pyramid: tuple  # tuple of (B, H>>i, W>>i, C)
+    fmap1: jax.Array  # (B, H, W, C), fp32
+    fmap2_pyramid: tuple  # tuple of (B, H>>i, W>>i, C) in the storage dtype
     batch: int = flax.struct.field(pytree_node=False)
     ht: int = flax.struct.field(pytree_node=False)
     wd: int = flax.struct.field(pytree_node=False)
     radius: int = flax.struct.field(pytree_node=False)
     row_chunk: Optional[int] = flax.struct.field(pytree_node=False, default=None)
     use_pallas: bool = flax.struct.field(pytree_node=False, default=False)
+    # per-level fp32 scalar dequantization scales for int8-stored fmap2
+    # levels (ops/quant.py); None for fp32/bf16. Correlation is linear in
+    # fmap2, so corr(f1, s*q) = s * corr(f1, q): the scale multiplies the
+    # looked-up window AFTER the kernel — the quantized level is what
+    # streams from HBM, and no dequantized copy is ever materialized.
+    scales: Optional[tuple] = None
+
+    def level_scale(self, i: int) -> Optional[jax.Array]:
+        return self.scales[i] if self.scales is not None else None
 
     def __call__(self, coords: jax.Array) -> jax.Array:
         """coords (B, H, W, 2) in level-0 pixels -> (B, H, W, L*(2r+1)^2)."""
@@ -129,6 +139,9 @@ class LocalCorr:
             else:
                 corr = local_corr_level(
                     self.fmap1, f2, coords_i, self.radius, self.row_chunk)
+            scale = self.level_scale(i)
+            if scale is not None:
+                corr = corr * scale
             out.append(corr)
         return jnp.concatenate(out, axis=-1).astype(jnp.float32)
 
@@ -140,13 +153,24 @@ def build_local_corr(
     radius: int = 4,
     row_chunk: Optional[int] = None,
     use_pallas: bool = False,
+    dtype: str = "fp32",
 ) -> LocalCorr:
-    """Build the pooled-fmap2 pyramid (no volume materialization)."""
+    """Build the pooled-fmap2 pyramid (no volume materialization).
+
+    ``dtype`` sets the STORAGE precision of the fmap2 pyramid (the tensor
+    every on-demand lookup streams; fmap1 stays fp32 — it is read once
+    per pixel block, not once per lattice point). Pooling runs fp32; each
+    level is then stored bf16/int8 with a per-level scale (ops/quant.py)
+    and the lookup dequantizes in-register.
+    """
     b, h, w, _ = fmap1.shape
     f1 = fmap1.astype(jnp.float32)
-    levels = [fmap2.astype(jnp.float32)]
+    pooled = [fmap2.astype(jnp.float32)]
     for _ in range(num_levels - 1):
-        levels.append(avg_pool_2x2(levels[-1]))
+        pooled.append(avg_pool_2x2(pooled[-1]))
+    stored = [store_corr(lvl, dtype) for lvl in pooled]
     return LocalCorr(
-        fmap1=f1, fmap2_pyramid=tuple(levels), batch=b, ht=h, wd=w,
-        radius=radius, row_chunk=row_chunk, use_pallas=use_pallas)
+        fmap1=f1, fmap2_pyramid=tuple(s[0] for s in stored),
+        batch=b, ht=h, wd=w,
+        radius=radius, row_chunk=row_chunk, use_pallas=use_pallas,
+        scales=(tuple(s[1] for s in stored) if dtype == "int8" else None))

@@ -81,9 +81,9 @@ def _variant() -> str:
     return v if v in ("loop", "batched") else "loop"
 
 
-def _blend_corners(lattice, frac_ref, out_ref):
-    """Bilinear-blend the (P, k, k) integer-lattice dots into the
-    (P, win*win) output window, x offset on the slow axis (the reference
+def _blend_corners_val(lattice, frac_ref):
+    """Bilinear-blend the (P, k, k) integer-lattice dots into a
+    (P, win*win) window value, x offset on the slow axis (the reference
     channel order — ops.corr)."""
     p_block, k, _ = lattice.shape
     win = k - 1
@@ -95,7 +95,11 @@ def _blend_corners(lattice, frac_ref, out_ref):
     br = lattice[:, 1:win + 1, 1:win + 1]
     out = ((1 - fy) * (1 - fx) * tl + (1 - fy) * fx * tr
            + fy * (1 - fx) * bl + fy * fx * br)
-    out_ref[0] = out.swapaxes(1, 2).reshape(p_block, win * win)
+    return out.swapaxes(1, 2).reshape(p_block, win * win)
+
+
+def _blend_corners(lattice, frac_ref, out_ref):
+    out_ref[0] = _blend_corners_val(lattice, frac_ref)
 
 
 def _corr_kernel_batched(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref,
@@ -112,7 +116,8 @@ def _corr_kernel_batched(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref,
     def body(p, _):
         sx = sx_ref[0, p]
         sy = sy_ref[0, p]
-        patches_ref[pl.ds(p, 1)] = f2_ref[0, pl.ds(sy, k), pl.ds(sx, k), :][None]
+        patches_ref[pl.ds(p, 1)] = (
+            f2_ref[0, pl.ds(sy, k), pl.ds(sx, k), :].astype(jnp.float32)[None])
         return 0
 
     jax.lax.fori_loop(0, p_block, body, 0)
@@ -135,8 +140,16 @@ def _corr_kernel_batched(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref,
     _blend_corners(dots, frac_ref, out_ref)
 
 
-def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
-                 lattice_ref, *, radius: int, h2: int, w2: int):
+def _fill_lattice_dots(sx_ref, sy_ref, f1_ref, f2_ref, lattice_ref,
+                       *, radius: int, h2: int, w2: int):
+    """Per-pixel slice+dot+mask loop shared by the per-level loop kernel
+    and the fused kernel: stage each pixel's (k, k) integer-lattice dots
+    (fp32 accumulate, storage dtype upcast in-register) into lattice_ref.
+
+    Masking: lattice points outside the ORIGINAL (unpadded) frame read
+    zero; slice starts were clipped into the padded frame, so the true
+    lattice origin is recomputed as x0 = sx - (r + 2), y0 = sy - (r + 2).
+    """
     r = radius
     k = 2 * r + 2
     p_block = f1_ref.shape[1]
@@ -152,9 +165,6 @@ def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
             patch.astype(jnp.float32) * f1p.astype(jnp.float32)[None, None, :],
             axis=2,
         )  # (k, k)
-        # mask lattice points outside the ORIGINAL (unpadded) frame;
-        # slice starts were clipped into the padded frame, so recompute
-        # the true lattice origin: x0 = sx - (r + 2), y0 = sy - (r + 2)
         gx = jax.lax.broadcasted_iota(jnp.int32, (k, k), 1) + (sx - 2 - 2 * r)
         gy = jax.lax.broadcasted_iota(jnp.int32, (k, k), 0) + (sy - 2 - 2 * r)
         valid = ((gx >= 0) & (gx < w2) & (gy >= 0) & (gy < h2))
@@ -164,6 +174,13 @@ def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
 
     jax.lax.fori_loop(0, p_block, body, 0)
 
+
+def _corr_kernel(sx_ref, sy_ref, f1_ref, f2_ref, frac_ref, out_ref,
+                 lattice_ref, *, radius: int, h2: int, w2: int):
+    k = 2 * radius + 2
+    p_block = f1_ref.shape[1]
+    _fill_lattice_dots(sx_ref, sy_ref, f1_ref, f2_ref, lattice_ref,
+                       radius=radius, h2=h2, w2=w2)
     _blend_corners(lattice_ref[:].reshape(p_block, k, k), frac_ref, out_ref)
 
 
@@ -178,19 +195,15 @@ def _pallas_forward(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
     win = 2 * r + 1
     pad = k  # 2r+2 zeros on every side
 
-    # ---- XLA-side index prep ----
-    x = jnp.clip(coords[..., 0].astype(jnp.float32), -(r + 1.0), w2 - 1 + r + 1.0)
-    y = jnp.clip(coords[..., 1].astype(jnp.float32), -(r + 1.0), h2 - 1 + r + 1.0)
-    x0 = jnp.floor(x)
-    y0 = jnp.floor(y)
-    frac = jnp.stack([x - x0, y - y0], axis=-1)  # (B, H, W, 2)
-    # slice start in the padded frame: x0 - r + pad = x0 + r + 2, in range
-    # [1, w2 + 2r + 2] given the clip above — always a legal k-slice
-    sx = x0.astype(jnp.int32) + (r + 2)
-    sy = y0.astype(jnp.int32) + (r + 2)
+    # ---- XLA-side index prep (shared with the fused kernel; slice
+    # start in the padded frame is x0 - r + pad = x0 + r + 2, in range
+    # [1, w2 + 2r + 2] given the clip — always a legal k-slice) ----
+    sx, sy, frac = _index_prep(coords, h2, w2, r)
 
-    f2p = jnp.pad(fmap2.astype(jnp.float32),
-                  ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    # pad in the STORAGE dtype (fp32/bf16/int8 — ops/quant.py): the
+    # quantized bytes are what stream HBM->VMEM; the kernel upcasts each
+    # patch in-register (patch.astype(f32) in the dot)
+    f2p = jnp.pad(fmap2, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
 
     # flatten pixels, pad to the block size
     pixel_block = _pixel_block()
@@ -283,3 +296,257 @@ def _bwd(radius, interpret, row_chunk, res, g):
 
 
 pallas_local_corr_level.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused refinement-step kernel: 4-level lookup + motion-encoder entry
+# ---------------------------------------------------------------------------
+#
+# The per-level kernel above still writes each level's (B, H, W, win^2)
+# window to HBM, where XLA's motion encoder reads the concatenated
+# (B, H, W, L*win^2) tensor back for its 1x1 corr conv — two full HBM
+# round-trips of the widest activation in the refinement loop. The fused
+# kernel does the whole chain in ONE pallas_call per iteration: every
+# pyramid level's window is computed while the pixel block's patches are
+# VMEM-resident and immediately contracted against that level's slice of
+# the motion encoder's 1x1 conv weight (an MXU matmul), so only the
+# (B, H, W, F) conv OUTPUT ever touches HBM. F=256 vs L*win^2=324 plus
+# the per-level intermediates: the loop's widest tensors never leave
+# VMEM. Division of labor for the linear factors: the kernel applies
+# 1/sqrt(C) itself (inside _fill_lattice_dots, same as the per-level
+# kernel — do NOT fold it into the weights too); the caller folds ONLY
+# the per-level int8 dequantization scales into the weight slices
+# (models/update.py FusedCorrEncoder). The kernel reads the pyramid in
+# its storage dtype (fp32/bf16/int8) and upcasts in-register.
+
+
+def _fused_kernel(*refs, radius: int, num_levels: int, level_shapes: tuple):
+    """refs: f1, w, b, then [sx, sy, frac, f2p] per level, out, lattice.
+
+    Per level: the per-pixel patch slice+dot of _corr_kernel, the corner
+    blend, then window @ w_level accumulated into the block's (P, F)
+    output — all while resident in VMEM.
+    """
+    f1_ref, w_ref, b_ref = refs[0], refs[1], refs[2]
+    lvl_refs = refs[3:3 + 4 * num_levels]
+    out_ref, lattice_ref = refs[3 + 4 * num_levels], refs[4 + 4 * num_levels]
+
+    r = radius
+    k = 2 * r + 2
+    win = 2 * r + 1
+    p_block = f1_ref.shape[1]
+
+    acc = jnp.broadcast_to(b_ref[0].astype(jnp.float32),
+                           (p_block, b_ref.shape[1]))
+    for lvl in range(num_levels):
+        sx_ref, sy_ref, frac_ref, f2_ref = lvl_refs[4 * lvl:4 * lvl + 4]
+        h2, w2 = level_shapes[lvl]
+        # same per-pixel slice+dot+mask as the per-level loop kernel
+        # (shared helper — ONE copy of the lattice-origin arithmetic)
+        _fill_lattice_dots(sx_ref, sy_ref, f1_ref, f2_ref, lattice_ref,
+                           radius=r, h2=h2, w2=w2)
+        window = _blend_corners_val(
+            lattice_ref[:].reshape(p_block, k, k), frac_ref)  # (P, win^2)
+        w_lvl = w_ref[pl.ds(lvl * win * win, win * win), :]
+        acc = acc + jnp.dot(window, w_lvl.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+    out_ref[0] = acc
+
+
+def _index_prep(coords: jax.Array, h2: int, w2: int, radius: int):
+    """XLA-side index prep for one level (the same clip/floor/frac as
+    _pallas_forward, at this level's geometry)."""
+    r = radius
+    x = jnp.clip(coords[..., 0].astype(jnp.float32),
+                 -(r + 1.0), w2 - 1 + r + 1.0)
+    y = jnp.clip(coords[..., 1].astype(jnp.float32),
+                 -(r + 1.0), h2 - 1 + r + 1.0)
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    frac = jnp.stack([x - x0, y - y0], axis=-1)
+    sx = x0.astype(jnp.int32) + (r + 2)
+    sy = y0.astype(jnp.int32) + (r + 2)
+    return sx, sy, frac
+
+
+# combined VMEM budget for the padded fmap2 levels a single fused call
+# may stage (bytes). ~16 MiB/core total minus the f1/weight/out/lattice
+# blocks and double-buffering headroom. At the 440x1024 eval geometry the
+# four padded fp32 levels need ~18 MB — over budget — so the fp32 fused
+# path splits into per-level fused calls (each holds ONE level, the
+# footprint the per-level kernel already proves fits); bf16 (~9 MB) and
+# int8 (~4.5 MB) stay single-call, which is the configuration the fused
+# kernel exists for. Env-overridable for on-chip tuning.
+_FUSED_LEVELS_VMEM_BYTES = 12 * 1024 * 1024
+
+
+def _fused_levels_budget() -> int:
+    import os
+
+    return int(os.environ.get("DEXIRAFT_FUSED_LEVELS_VMEM_BYTES",
+                              _FUSED_LEVELS_VMEM_BYTES))
+
+
+def _fused_forward(fmap1: jax.Array, fmap2_levels: tuple, coords: jax.Array,
+                   weight: jax.Array, bias: jax.Array, radius: int,
+                   interpret=None) -> jax.Array:
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, w, c = fmap1.shape
+    r = radius
+    k = 2 * r + 2
+    win = 2 * r + 1
+    pad = k
+    num_levels = len(fmap2_levels)
+    feat = weight.shape[1]
+    level_shapes = tuple(f2.shape[1:3] for f2 in fmap2_levels)
+
+    if num_levels > 1:
+        staged = sum((h2 + 2 * pad) * (w2 + 2 * pad) * c * f2.dtype.itemsize
+                     for (h2, w2), f2 in zip(level_shapes, fmap2_levels))
+        if staged > _fused_levels_budget():
+            # over the VMEM budget (fp32 pyramid at large geometry):
+            # one fused lookup+conv call PER level — each stages a single
+            # level, still contracting its window against the weight
+            # slice in-kernel, and the (B, H, W, win^2) per-level corr
+            # features still never materialize; only L partial (B,H,W,F)
+            # products are summed in XLA. Exactly linear, so identical
+            # to the single-call result up to summation order.
+            ww = win * win
+            out = None
+            zero_bias = jnp.zeros_like(bias)
+            for lvl in range(num_levels):
+                o = _fused_forward(
+                    fmap1, (fmap2_levels[lvl],), coords / (2.0 ** lvl),
+                    weight[lvl * ww:(lvl + 1) * ww], zero_bias, radius,
+                    interpret)
+                out = o if out is None else out + o
+            return out + bias.astype(jnp.float32)
+
+    import os
+
+    # the fused kernel has the loop kernel's VMEM shape (one (P, k*k)
+    # lattice scratch), so it shares the loop default — not the batched
+    # variant's small block
+    pixel_block = max(1, int(os.environ.get("DEXIRAFT_PALLAS_PIXEL_BLOCK",
+                                            _PIXEL_BLOCK)))
+    n = h * w
+    n_pad = (-n) % pixel_block
+    np_tot = n + n_pad
+    flat = lambda a, d: jnp.pad(a.reshape(b, n, *a.shape[3:]),
+                                ((0, 0), (0, n_pad)) + ((0, 0),) * d)
+
+    f1_flat = flat(fmap1.astype(jnp.float32), 1)
+
+    grid = (b, np_tot // pixel_block)
+    smem_spec = pl.BlockSpec((1, pixel_block), lambda bi, ti: (bi, ti),
+                             memory_space=pltpu.SMEM)
+    frac_spec = pl.BlockSpec((1, pixel_block, 2), lambda bi, ti: (bi, ti, 0),
+                             memory_space=pltpu.VMEM)
+    f1_spec = pl.BlockSpec((1, pixel_block, c), lambda bi, ti: (bi, ti, 0),
+                           memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((num_levels * win * win, feat),
+                          lambda bi, ti: (0, 0), memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((1, feat), lambda bi, ti: (0, 0),
+                          memory_space=pltpu.VMEM)
+
+    inputs = [f1_flat, weight.astype(jnp.float32),
+              bias.reshape(1, feat).astype(jnp.float32)]
+    in_specs = [f1_spec, w_spec, b_spec]
+    for lvl, f2 in enumerate(fmap2_levels):
+        h2, w2 = level_shapes[lvl]
+        sx, sy, frac = _index_prep(coords / (2.0 ** lvl), h2, w2, r)
+        # pad each level in its STORAGE dtype — the quantized bytes are
+        # what stream HBM->VMEM
+        f2p = jnp.pad(f2, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        inputs += [flat(sx, 0), flat(sy, 0), flat(frac, 1), f2p]
+        in_specs += [
+            smem_spec, smem_spec, frac_spec,
+            pl.BlockSpec((1, h2 + 2 * pad, w2 + 2 * pad, c),
+                         lambda bi, ti: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+
+    kernel = functools.partial(_fused_kernel, radius=r,
+                               num_levels=num_levels,
+                               level_shapes=level_shapes)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, pixel_block, feat),
+                               lambda bi, ti: (bi, ti, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, np_tot, feat), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((pixel_block, k * k), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)
+    return out[:, :n].reshape(b, h, w, feat)
+
+
+def fused_reference(fmap1, fmap2_levels, coords, weight, bias, radius,
+                    row_chunk=None):
+    """The unfused XLA formulation of the fused kernel — per-level
+    local_corr_level windows concatenated, then the 1x1 conv as a plain
+    contraction. The parity/gradient reference AND the backward-pass
+    recompute target of pallas_fused_step (the same split as
+    pallas_local_corr_level's VJP: hand-written forward kernel, XLA
+    matmul backward).
+
+    ``weight`` is (L*win^2, F) with any per-level dequantization scales
+    already folded in (the caller's job — FusedCorrEncoder); levels may
+    be stored bf16/int8, upcast here exactly as the kernel upcasts.
+    """
+    b, h, w, _ = fmap1.shape
+    outs = []
+    for lvl, f2 in enumerate(fmap2_levels):
+        outs.append(local_corr_level(
+            fmap1, f2.astype(jnp.float32), coords / (2.0 ** lvl), radius,
+            row_chunk=row_chunk))
+    corr = jnp.concatenate(outs, axis=-1)  # (B, H, W, L*win^2)
+    return (jnp.einsum("bhwc,cf->bhwf", corr, weight.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+            + bias.astype(jnp.float32))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def pallas_fused_step(fmap1, fmap2_levels, coords, weight, bias,
+                      radius: int, interpret=None, row_chunk=8):
+    """Fused lookup+update-entry: (B,H,W,C) x L levels x level-0 coords x
+    (L*(2r+1)^2, F) weight x (F,) bias -> (B,H,W,F).
+
+    One Pallas call per refinement iteration: the full multi-level window
+    lookup feeds the motion encoder's 1x1 corr conv while each pixel
+    block's patches are VMEM-resident (see module comment). interpret=None
+    defers to DEXIRAFT_PALLAS_INTERPRET; row_chunk bounds the backward
+    recompute's transient buffer like the per-level kernel's VJP.
+
+    Gradients flow to fmap1, float-dtype fmap2 levels, weight, and bias
+    by recomputing through fused_reference; coords get zero gradient
+    (the CUDA-kernel semantics shared by every corr path). int8-stored
+    levels are non-differentiable by construction (their float0
+    cotangent falls out of jax.vjp) — the model layer refuses to train
+    int8 pyramids rather than training with dead fmap2 gradients.
+    """
+    return _fused_forward(fmap1, tuple(fmap2_levels), coords, weight, bias,
+                          radius, interpret)
+
+
+def _fused_fwd(fmap1, fmap2_levels, coords, weight, bias, radius, interpret,
+               row_chunk):
+    out = _fused_forward(fmap1, tuple(fmap2_levels), coords, weight, bias,
+                         radius, interpret)
+    return out, (fmap1, tuple(fmap2_levels), coords, weight, bias)
+
+
+def _fused_bwd(radius, interpret, row_chunk, res, g):
+    fmap1, fmap2_levels, coords, weight, bias = res
+    _, vjp = jax.vjp(
+        lambda f1, f2s, w_, b_: fused_reference(
+            f1, f2s, coords, w_, b_, radius, row_chunk=row_chunk),
+        fmap1, fmap2_levels, weight, bias)
+    g1, g2s, gw, gb = vjp(g)
+    return g1, g2s, jnp.zeros_like(coords), gw, gb
+
+
+pallas_fused_step.defvjp(_fused_fwd, _fused_bwd)

@@ -1,0 +1,88 @@
+"""Low-precision storage for the correlation volume / fmap2 pyramid.
+
+The ~200 MB all-pairs pyramid (and the fmap2 pyramid the on-demand paths
+stream every iteration) is the HBM-bandwidth term of the refinement loop
+(docs/perf.md "Correlation memory & precision"). Storing it below fp32
+halves (bf16) or quarters (int8) the bytes each lookup moves; the values
+are dequantized *inside* the consuming matmul/kernel so no fp32 copy is
+ever materialized in HBM.
+
+Quantization is symmetric per-tensor (one fp32 scale per pyramid level):
+correlation volumes are zero-centered dot products, so an asymmetric
+zero-point buys nothing and would cost an extra add on the hot path.
+Dequantization is exactly linear (x ~ scale * q), which is what lets the
+scale be folded into whatever linear op consumes the values — the lookup
+window blend, or the motion encoder's 1x1 conv weights in the fused
+Pallas kernel (ops/pallas_corr.py).
+
+Gradients: the bf16 cast is differentiable (cotangents cast back); the
+int8 round is not — int8 is an inference-format, and the model layer
+refuses to train with it (models/raft.py) rather than silently training
+with dead fmap gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# the CLI/config-facing vocabulary lives jax-free in config.py; this is
+# the same tuple object, re-exported for ops-side callers
+from dexiraft_tpu.config import CORR_DTYPES  # noqa: E402
+
+
+def quantize_symmetric(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """x (any shape, float) -> (int8 values, fp32 scalar scale).
+
+    scale = max|x| / 127, so dequantization ``q * scale`` covers the full
+    observed range with per-value error <= scale/2. The max is guarded
+    away from zero so an all-zero tensor quantizes to zeros with a finite
+    scale instead of NaN.
+    """
+    if x.size == 0:
+        # degenerate pyramid tail (a 1x1 level pools to zero rows) —
+        # nothing to quantize, but the level must keep flowing through
+        # the lookup's (empty) contractions with a well-defined scale
+        return x.astype(jnp.int8), jnp.float32(1.0)
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.maximum(amax, jnp.float32(1e-12)) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def store_corr(x: jax.Array, corr_dtype: str
+               ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Cast a correlation-pyramid level to its storage dtype.
+
+    Returns (stored array, scale) where scale is None for the
+    scale-free dtypes (fp32/bf16) and a fp32 scalar for int8.
+    """
+    if corr_dtype == "fp32":
+        return x.astype(jnp.float32), None
+    if corr_dtype == "bf16":
+        return x.astype(jnp.bfloat16), None
+    if corr_dtype == "int8":
+        return quantize_symmetric(x)
+    raise ValueError(
+        f"unknown corr_dtype {corr_dtype!r}; expected one of {CORR_DTYPES}")
+
+
+def dequantize(x: jax.Array, scale: Optional[jax.Array]) -> jax.Array:
+    """Stored level -> fp32 values. The inverse of store_corr; inside jit
+    the convert fuses into the consuming matmul's operand read, so this
+    costs no extra HBM pass."""
+    out = x.astype(jnp.float32)
+    if scale is not None:
+        out = out * scale
+    return out
+
+
+def corr_dtype_bytes(corr_dtype: str) -> int:
+    """Bytes per stored correlation value (the bytes-moved estimator of
+    scripts/micro_bench.py --corr_dtype)."""
+    if corr_dtype not in CORR_DTYPES:
+        raise ValueError(
+            f"unknown corr_dtype {corr_dtype!r}; expected one of {CORR_DTYPES}")
+    return {"fp32": 4, "bf16": 2, "int8": 1}[corr_dtype]

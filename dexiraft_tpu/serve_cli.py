@@ -40,6 +40,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--corr_impl", default="allpairs",
                    choices=["allpairs", "local", "pallas"])
+    p.add_argument("--corr_dtype", default="fp32",
+                   choices=["fp32", "bf16", "int8"],
+                   help="correlation-pyramid storage precision (bf16 "
+                        "halves / int8 quarters per-request HBM traffic)")
+    p.add_argument("--fused_update", action="store_true",
+                   help="one fused Pallas lookup+update kernel per "
+                        "refinement iteration (requires --corr_impl "
+                        "pallas)")
     p.add_argument("--scan_unroll", type=int, default=1)
     p.add_argument("--dexined_upconv", default="subpixel",
                    choices=["transpose", "subpixel"])
@@ -159,9 +167,13 @@ def _load(args):
         ckpt.require_checkpoints(args.model)
     except FileNotFoundError as e:
         raise SystemExit(f"serve: {e}")
+    if args.fused_update and args.corr_impl != "pallas":
+        raise SystemExit("serve: --fused_update requires --corr_impl pallas")
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
                                  corr_impl=args.corr_impl,
+                                 corr_dtype=args.corr_dtype,
+                                 fused_update=args.fused_update,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())

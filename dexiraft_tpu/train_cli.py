@@ -44,6 +44,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--corr_impl", default="allpairs",
                    choices=["allpairs", "local", "pallas"])
+    p.add_argument("--corr_dtype", default="fp32", choices=["fp32", "bf16"],
+                   help="storage precision of the correlation pyramid "
+                        "(halves HBM traffic of the refinement loop at "
+                        "bf16; int8 is inference-only — eval/serve)")
+    p.add_argument("--fused_update", action="store_true",
+                   help="fuse each iteration's 4-level lookup with the "
+                        "motion encoder's corr conv into one Pallas "
+                        "kernel (requires --corr_impl pallas; identical "
+                        "param tree, checkpoints interchange)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize refinement iterations in backward "
                         "(HBM savings at ~1 extra forward of FLOPs)")
@@ -182,10 +191,16 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         mixed_precision=args.mixed_precision,
         dropout=args.dropout,
         corr_impl=args.corr_impl,
+        corr_dtype=args.corr_dtype,
+        fused_update=args.fused_update,
         remat=args.remat,
         remat_lookup=args.remat_lookup,
         dexined_upconv=args.dexined_upconv,
     )
+    if cfg.fused_update and cfg.corr_impl != "pallas":
+        raise SystemExit("train: --fused_update requires --corr_impl pallas "
+                         "(the fused step kernel is the VMEM lookup "
+                         "formulation)")
 
     if args.preset != "none":
         stages = (cfglib.STANDARD_STAGES if args.preset == "standard"

@@ -7,11 +7,16 @@ block through the relay tunnel):
   dexi_b_bf16  the shipped DexiNed prelude (one batched bf16 call)
   enc_x4       4 encoder passes at eval res
   lookup32     32 chained corr_lookup calls (both streams, carry-dependent)
+  lkp32_<dt>   the same loop with the pyramid stored fp32/bf16/int8
+               (--corr_dtype sweep; each line also reports the estimated
+               correlation bytes each lookup streams from HBM — the
+               quantization win made legible even on the CPU fallback)
   forward      the full v5 test-mode forward (sanity: ~ sum of the above)
   fwd_iter1    iters=1 forward -> per-iteration + prelude split
   fwd_sp_unr4  candidate config: scan_unroll=4 (XLA software pipelining)
 
 Run:  python scripts/micro_bench.py [--impl allpairs]
+                                    [--corr_dtype {fp32,bf16,int8,all}]
 """
 
 from __future__ import annotations
@@ -33,26 +38,62 @@ ITERS = 32
 _RTT = [0.0]
 
 
-def timeit(name, fn, *args, reps=3):
+def timeit(name, fn, *args, reps=3, strict=False):
     """fn must return a pytree; it is reduced to ONE device scalar inside
-    jit so the sync fetch costs exactly one tunnel round-trip."""
+    jit so the sync fetch costs exactly one tunnel round-trip.
+
+    strict=True arms guards.strict_mode around the post-warmup reps (the
+    PR 5 steady-state contract): a retrace or implicit transfer inside
+    the timed window fails the run instead of deflating the number."""
     reduced = jax.jit(
         lambda *a: jax.tree_util.tree_reduce(
             lambda acc, x: acc + jnp.sum(x).astype(jnp.float32),
             fn(*a), jnp.float32(0)))
     float(jax.device_get(reduced(*args)))  # compile + warmup
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        # explicit scalar fetch = the sync (jaxlint JL007)
-        float(jax.device_get(reduced(*args)))
-    dt = (time.perf_counter() - t0) / reps
+    import contextlib
+
+    from dexiraft_tpu.analysis import guards
+
+    ctx = (guards.strict_mode(label=f"micro_bench:{name}") if strict
+           else contextlib.nullcontext())
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            # explicit scalar fetch = the sync (jaxlint JL007)
+            float(jax.device_get(reduced(*args)))
+        dt = (time.perf_counter() - t0) / reps
     print(f"{name:>11s}: {dt * 1e3:8.1f} ms   (-rtt {max(dt - _RTT[0], 0) * 1e3:8.1f} ms)")
     return dt
+
+
+def corr_bytes_per_lookup(batch: int, h8: int, w8: int, num_levels: int,
+                          corr_dtype: str) -> int:
+    """Estimated bytes ONE all-pairs corr_lookup streams from HBM: every
+    pyramid level is read once per lookup by the windowing matmuls
+    (interp_window is volume-streaming by construction — docs/perf.md).
+    Level dims floor-halve exactly like build_corr_pyramid's VALID pool."""
+    from dexiraft_tpu.ops.quant import corr_dtype_bytes
+
+    n = batch * h8 * w8
+    total = 0
+    hl, wl = h8, w8
+    for _ in range(num_levels):
+        total += n * hl * wl * corr_dtype_bytes(corr_dtype)
+        hl, wl = hl // 2, wl // 2
+    return total
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--impl", default="allpairs")
+    ap.add_argument("--corr_dtype", default="all",
+                    choices=["fp32", "bf16", "int8", "all"],
+                    help="pyramid storage precision(s) for the lkp32 "
+                         "sweep ('all' = sweep the three)")
+    ap.add_argument("--corr_sweep_only", action="store_true",
+                    help="run rtt + the corr_dtype lookup sweep and exit "
+                         "— the CPU-fallback A/B (the full component "
+                         "profile costs minutes off-chip)")
     args = ap.parse_args()
 
     from dexiraft_tpu.config import raft_v5
@@ -76,7 +117,42 @@ def main() -> None:
         p2 = build_corr_pyramid(f2, f1, 4, 4)
         return p1.levels + p2.levels
 
-    timeit("volume", volume, f1, f2)
+    if not args.corr_sweep_only:
+        timeit("volume", volume, f1, f2)
+
+    # --- pyramid storage-precision sweep (ISSUE 8): 32 chained 2-stream
+    # lookups with the volume stored fp32/bf16/int8, timed inside a
+    # strict steady-state window (a retrace or implicit transfer FAILS
+    # the run), plus the bytes each lookup streams — the quantization
+    # lever is bandwidth, so the bytes column is the prediction and the
+    # ms column the measurement ---
+    dtypes = (("fp32", "bf16", "int8") if args.corr_dtype == "all"
+              else (args.corr_dtype,))
+    t_by_dtype = {}
+    for dt in dtypes:
+        def lookup32_q(f1, f2, dt=dt):
+            pyr = build_corr_pyramid(f1, f2, 4, 4, dtype=dt)
+            pyr2 = build_corr_pyramid(f2, f1, 4, 4, dtype=dt)
+            coords = coords_grid(1, h8, w8)
+
+            def body(co, _):
+                s = corr_lookup(pyr, co)
+                s2 = corr_lookup(pyr2, co)
+                co = co + 0.01 * (s.mean(axis=-1, keepdims=True)
+                                  + s2.mean(axis=-1, keepdims=True))
+                return co, None
+
+            co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+            return co
+
+        t_q = timeit(f"lkp32_{dt}", lookup32_q, f1, f2, strict=True)
+        t_by_dtype[dt] = t_q
+        mb = 2 * corr_bytes_per_lookup(1, h8, w8, 4, dt) / 1e6  # 2 streams
+        print(f"  -> {dt}: {mb:8.1f} MB corr bytes/lookup, "
+              f"{t_q / ITERS * 1e3:6.1f} ms/iter "
+              f"({mb / max(t_q / ITERS, 1e-9) / 1e3:6.2f} GB/s implied)")
+    if args.corr_sweep_only:
+        return
 
     # --- DexiNed + encoders at eval res ---
     # (the historical fp32 two-call "dexined_x2" comparison is gone: its
@@ -113,25 +189,31 @@ def main() -> None:
 
     timeit("enc_x4", enc4, big)
 
-    # --- 32 chained lookups (2 streams) ---
-    @jax.jit
-    def lookup32(f1, f2):
-        pyr = build_corr_pyramid(f1, f2, 4, 4)
-        pyr2 = build_corr_pyramid(f2, f1, 4, 4)
-        coords = coords_grid(1, h8, w8)
+    # --- 32 chained lookups (2 streams): identical to the sweep's fp32
+    # leg, so reuse its timing when it ran instead of compiling and
+    # measuring the same scan twice ---
+    if "fp32" in t_by_dtype:
+        t_lookup = t_by_dtype["fp32"]
+        print(f"{'lookup32':>11s}: = lkp32_fp32 ({t_lookup * 1e3:8.1f} ms)")
+    else:
+        @jax.jit
+        def lookup32(f1, f2):
+            pyr = build_corr_pyramid(f1, f2, 4, 4)
+            pyr2 = build_corr_pyramid(f2, f1, 4, 4)
+            coords = coords_grid(1, h8, w8)
 
-        def body(carry, _):
-            co = carry
-            s = corr_lookup(pyr, co)
-            s2 = corr_lookup(pyr2, co)
-            co = co + 0.01 * (s.mean(axis=-1, keepdims=True)
-                              + s2.mean(axis=-1, keepdims=True))
-            return co, None
+            def body(carry, _):
+                co = carry
+                s = corr_lookup(pyr, co)
+                s2 = corr_lookup(pyr2, co)
+                co = co + 0.01 * (s.mean(axis=-1, keepdims=True)
+                                  + s2.mean(axis=-1, keepdims=True))
+                return co, None
 
-        co, _ = jax.lax.scan(body, coords, None, length=ITERS)
-        return co
+            co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+            return co
 
-    t_lookup = timeit("lookup32", lookup32, f1, f2)
+        t_lookup = timeit("lookup32", lookup32, f1, f2)
 
     # --- full forward ---
     from dexiraft_tpu.config import raft_v5

@@ -53,6 +53,14 @@ def main():
                     help="timed steady-state steps")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--remat_lookup", action="store_true")
+    ap.add_argument("--corr_impl", default="allpairs",
+                    choices=["allpairs", "local", "pallas"])
+    ap.add_argument("--corr_dtype", choices=["fp32", "bf16"], default="fp32",
+                    help="correlation-pyramid storage precision (int8 is "
+                         "inference-only, so not offered here)")
+    ap.add_argument("--fused_update", action="store_true",
+                    help="fused Pallas lookup+update step kernel "
+                         "(requires --corr_impl pallas)")
     ap.add_argument("--compile_cache_dir", default=None,
                     help="persistent XLA cache dir "
                          "(default logs/xla_cache)")
@@ -70,6 +78,8 @@ def main():
                          "tunnel is down; config.update beats the "
                          "axon site-hook pin)")
     args = ap.parse_args()
+    if args.fused_update and args.corr_impl != "pallas":
+        ap.error("--fused_update requires --corr_impl pallas")
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
@@ -90,7 +100,8 @@ def main():
     # forces mixed_precision=True itself when precision=bf16)
     cfg = getattr(C, f"raft_{args.variant}")(
         mixed_precision=args.precision == "bf16", remat=args.remat,
-        remat_lookup=args.remat_lookup)
+        remat_lookup=args.remat_lookup, corr_impl=args.corr_impl,
+        corr_dtype=args.corr_dtype, fused_update=args.fused_update)
     h, w = args.size
     tc = TrainConfig(name="bench", num_steps=1000, batch_size=args.batch,
                      image_size=(h, w), iters=args.iters, lr=4e-4,

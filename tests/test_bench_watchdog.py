@@ -18,17 +18,44 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_record_schema_pinned():
+    """The ONE JSON line the driver greps is schema-pinned: required keys
+    (including this PR's corr_dtype/fused_update config naming), optional
+    conditional keys, and tag-prefixed per-config diagnostics — anything
+    else fails validate_record, so the record cannot drift silently."""
+    bench = _load_bench()
+    assert {"corr_dtype", "fused_update", "corr_impl",
+            "dexined_upconv"} <= bench.BENCH_RECORD_KEYS
+    rec = {k: None for k in bench.BENCH_RECORD_KEYS}
+    rec.update(allpairs_raw_ms=1.0, fused_pallas_int8_iters_per_sec=2.0,
+               local_transpose_rtt_ms=3.0, mfu=0.5)
+    bench.validate_record(rec)  # required + diag + optional: passes
+
+    with pytest.raises(ValueError, match="missing"):
+        bench.validate_record({k: None for k in
+                               bench.BENCH_RECORD_KEYS - {"corr_dtype"}})
+    bad = {k: None for k in bench.BENCH_RECORD_KEYS}
+    bad["surprise_key"] = 1
+    with pytest.raises(ValueError, match="unpinned"):
+        bench.validate_record(bad)
+
+
 def test_cpu_anchor_parse_keeps_freshest_per_geometry(tmp_path, monkeypatch):
     """The anchor script APPENDS on re-runs; the bench record carries one
     ratio per measured geometry, each the freshest for that geometry
     (ADVICE r3 + VERDICT r4 next-8). Malformed lines, key-missing lines,
     and legacy geometry-less records are skipped without losing good
     ones."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench()
 
     log = tmp_path / "logs" / "torch_cpu_anchor.log"
     log.parent.mkdir()
