@@ -57,6 +57,12 @@ Rule catalog (docs/static_analysis.md has the long-form version):
                            a fresh wrapper (and a retrace) per
                            iteration; hoist it.
 
+Sharding-contract rules JL010+ live in the sibling `shardlint.py`
+(loaded below by file path, so both the package import and
+lint_gate.py's path-load pick them up); they enforce that every
+PartitionSpec / mesh axis / sharding pin is drawn from the canonical
+layout in `parallel/layout.py` (docs/parallel.md).
+
 Suppression: `# jaxlint: disable=JL00X` on the offending line, or a
 reviewed entry in analysis/baseline.json (see lint_gate.py). Baseline
 entries match on (rule, path, stripped source line) so they survive
@@ -85,6 +91,24 @@ RULES: Dict[str, str] = {
     "JL008": "loop-sync",
     "JL009": "jit-in-loop",
 }
+
+
+def _load_shardlint():
+    """Load the sibling sharding-rule module by file path (mirrors how
+    lint_gate.py loads this file): works identically whether jaxlint was
+    imported as dexiraft_tpu.analysis.jaxlint or exec'd by path."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "shardlint.py")
+    spec = importlib.util.spec_from_file_location("_shardlint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_shardlint = _load_shardlint()
+RULES.update(_shardlint.RULES)
 
 # dotted names that mean "jax.jit" after alias resolution
 _JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit",
@@ -312,6 +336,7 @@ class _Linter:
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     self._rule_jl004(node)
         self._rule_jl007(mod.tree)
+        _shardlint.run_rules(self)  # JL010+ sharding-contract rules
         rel = mod.path.replace(os.sep, "/")
         if (rel.startswith(("dexiraft_tpu/train/", "dexiraft_tpu/eval/",
                             "dexiraft_tpu/serve/"))
@@ -836,6 +861,12 @@ class Baseline:
     def excludes(self, relpath: str) -> bool:
         return any(fnmatch.fnmatch(relpath, pat) for pat in self.exclude)
 
+    def exclude_matches(self, relpath: str) -> List[str]:
+        """Which exclude patterns this path satisfies (for the gate's
+        stale-exclude detection: a pattern matching no file in a full
+        tree walk excuses nothing and must be removed)."""
+        return [p for p in self.exclude if fnmatch.fnmatch(relpath, p)]
+
     def split(self, findings: List[Finding]
               ) -> Tuple[List[Finding], List[Finding], List[dict]]:
         """(kept, allowlisted, stale_entries)."""
@@ -855,9 +886,14 @@ class Baseline:
 
 def iter_py_files(root: str, subdirs: Sequence[str]) -> Iterable[Tuple[str, str]]:
     """Yield (abspath, repo-relative posix path) for every .py under the
-    given subdirs of root, sorted for determinism."""
+    given subdirs of root, sorted for determinism. An entry that IS a
+    .py file (the repo-root driver entry points) yields itself."""
     for sub in subdirs:
         base = os.path.join(root, sub)
+        if sub.endswith(".py"):
+            if os.path.isfile(base):
+                yield base, sub.replace(os.sep, "/")
+            continue
         for dirpath, dirnames, filenames in os.walk(base):
             dirnames[:] = sorted(d for d in dirnames
                                  if d != "__pycache__")
@@ -869,20 +905,44 @@ def iter_py_files(root: str, subdirs: Sequence[str]) -> Iterable[Tuple[str, str]
                 yield ab, rel
 
 
-def lint_tree(root: str, subdirs: Sequence[str] = ("dexiraft_tpu", "scripts"),
+DEFAULT_SUBDIRS = ("dexiraft_tpu", "scripts",
+                   # repo-root driver entries: the multichip dryrun
+                   # builds meshes and bench constructs step fns — both
+                   # inside the sharding contract's enforcement scope
+                   "__graft_entry__.py", "bench.py")
+
+
+def lint_tree(root: str, subdirs: Sequence[str] = DEFAULT_SUBDIRS,
               baseline: Optional[Baseline] = None,
               rules: Optional[Set[str]] = None):
     """Lint the tree; returns (kept, allowed, stale_entries, stats)."""
     findings: List[Finding] = []
     n_files = n_excluded = 0
+    matched_excludes: Set[str] = set()
     for ab, rel in iter_py_files(root, subdirs):
-        if baseline is not None and baseline.excludes(rel):
-            n_excluded += 1
-            continue
+        if baseline is not None:
+            hits = baseline.exclude_matches(rel)
+            if hits:
+                matched_excludes.update(hits)
+                n_excluded += 1
+                continue
         n_files += 1
         findings.extend(lint_file(ab, rel, rules))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # an explicit .py scope entry naming a vanished file must FAIL the
+    # gate, not silently shrink its coverage (same principle as stale
+    # excludes: the gate's reach never narrows without a signal)
+    missing_scope = [sub for sub in subdirs if sub.endswith(".py")
+                     and not os.path.isfile(os.path.join(root, sub))]
     if baseline is None:
-        return findings, [], [], {"files": n_files, "excluded": n_excluded}
+        return findings, [], [], {"files": n_files, "excluded": n_excluded,
+                                  "stale_excludes": [],
+                                  "missing_scope": missing_scope}
     kept, allowed, stale = baseline.split(findings)
-    return kept, allowed, stale, {"files": n_files, "excluded": n_excluded}
+    stats = {"files": n_files, "excluded": n_excluded,
+             # a full tree walk saw no file for these patterns: the
+             # excused code is gone, so the excuse must go too
+             "stale_excludes": [p for p in baseline.exclude
+                                if p not in matched_excludes],
+             "missing_scope": missing_scope}
+    return kept, allowed, stale, stats

@@ -1,0 +1,350 @@
+"""Shard audit — the dynamic half of the sharding contract.
+
+shardlint (JL010+) proves every spec is *drawn from* the canonical
+layout; this pass proves what the compiler actually *does* with them.
+It lowers and compiles the donated train step and the eval/serve steps
+on a forced 8-virtual-device host mesh (no TPU needed — GSPMD
+partitioning is platform-independent), reads every input/output leaf's
+resolved sharding off the compiled executables, and
+
+  * diffs the result against the checked-in golden
+    (``analysis/layout_golden.json``) — ANY drift is a nonzero exit, so
+    a silently changed spec fails CI the same way a lint finding does;
+  * resolves the layout's *declared* array groups (batch, carry, and
+    the ~200 MB all-pairs correlation volume — the canary) at the
+    production reference geometry and flags any group over a size
+    threshold that resolves fully replicated and is not pinned as
+    replicated-by-design in ``parallel.layout.REPLICATED_OK``.
+
+Run it via ``scripts/shard_audit.py`` (which forces the host platform
+before jax initializes); the tier-1 verify command runs it right after
+``lint_gate.py``. Regeneration workflow: docs/static_analysis.md.
+
+Granularity note: shardings are reported per GROUP (a state field, a
+batch key — e.g. ``[0].params`` or ``[1]['image1']``), each carrying
+the SET of distinct specs its leaves resolved to. That keeps the golden
+compact and stable across param-tree growth while still failing on any
+spec change (a single differently-pinned leaf adds a spec to its
+group's set).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "layout_golden.json")
+
+#: Audit geometry: small model + tiny frames keep the three compiles
+#: ~a minute on CPU; the SPECS resolved are geometry-independent.
+AUDIT_IMAGE = (48, 64)
+AUDIT_BATCH = 8
+AUDIT_ITERS = 2
+#: Production reference geometry for the declared-group size tripwire
+#: (Sintel serve shape; the per-sample all-pairs volume here is the
+#: ~200 MB canary).
+PROD_IMAGE = (440, 1024)
+PROD_BATCH = 8
+DEFAULT_THRESHOLD_MB = 64.0
+#: The audit's train/eval mesh (the MULTICHIP dryrun mesh) and the
+#: serve mesh, as {axis: size} over the 8 forced host devices.
+TRAIN_MESH = {"data": 4, "seq": 2}
+SERVE_MESH = {"data": 8}
+
+
+def _group_key(path: Tuple[Any, ...]) -> str:
+    """First two key-path entries — field-of-argument granularity."""
+    from jax.tree_util import keystr
+
+    return keystr(tuple(path[:2]))
+
+
+def _section(shardings, avals) -> Dict[str, Dict[str, Any]]:
+    """(shardings pytree, matching avals pytree) -> per-group summary:
+    sorted unique spec strings, leaf count, total/max leaf bytes."""
+    import numpy as np
+    from jax.tree_util import tree_flatten_with_path
+
+    from dexiraft_tpu.parallel.layout import spec_str
+
+    s_leaves = tree_flatten_with_path(shardings)[0]
+    a_leaves = tree_flatten_with_path(avals)[0]
+    groups: Dict[str, Dict[str, Any]] = {}
+    by_path = {tuple(p): s for p, s in s_leaves}
+    for path, aval in a_leaves:
+        sh = by_path.get(tuple(path))
+        if sh is None:
+            continue
+        key = _group_key(tuple(path))
+        g = groups.setdefault(key, {"specs": set(), "leaves": 0,
+                                    "bytes": 0, "max_leaf_bytes": 0})
+        g["specs"].add(spec_str(sh.spec))
+        g["leaves"] += 1
+        nbytes = int(np.prod(aval.shape, dtype=np.int64)
+                     * np.dtype(aval.dtype).itemsize)
+        g["bytes"] += nbytes
+        g["max_leaf_bytes"] = max(g["max_leaf_bytes"], nbytes)
+    return {k: {"specs": sorted(v["specs"]), "leaves": v["leaves"],
+                "bytes": v["bytes"], "max_leaf_bytes": v["max_leaf_bytes"]}
+            for k, v in sorted(groups.items())}
+
+
+def _mesh_dict(mesh) -> Dict[str, int]:
+    return {str(k): int(v) for k, v in mesh.shape.items()}
+
+
+def _compiled_sections(jitted, args: Tuple[Any, ...]) -> Dict[str, Any]:
+    """Lower+compile on abstract avals; return in/out group summaries."""
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    in_sh = compiled.input_shardings[0]  # (args, kwargs) — args side
+    out_sh = compiled.output_shardings
+    # output avals ride the Lowered we already have — an eval_shape here
+    # would re-trace the whole (grad-of-scan) step a second time
+    out_avals = lowered.out_info
+    return {"in": _section(in_sh, args), "out": _section(out_sh, out_avals)}
+
+
+def _audit_state(cfg, tc):
+    """Abstract TrainState (shapes/dtypes only — nothing allocated)."""
+    import jax
+
+    from dexiraft_tpu.train.state import create_state
+
+    return jax.eval_shape(
+        lambda: create_state(jax.random.PRNGKey(0), cfg, tc))
+
+
+def _batch_avals(batch_size: int, h: int, w: int):
+    import numpy as np
+    import jax
+
+    return {
+        "image1": jax.ShapeDtypeStruct((batch_size, h, w, 3), np.float32),
+        "image2": jax.ShapeDtypeStruct((batch_size, h, w, 3), np.float32),
+        "flow": jax.ShapeDtypeStruct((batch_size, h, w, 2), np.float32),
+        "valid": jax.ShapeDtypeStruct((batch_size, h, w), np.float32),
+    }
+
+
+def audit_train(mesh=None) -> Dict[str, Any]:
+    from dexiraft_tpu.config import TrainConfig, raft_v1
+    from dexiraft_tpu.parallel.layout import make_mesh_2d
+    from dexiraft_tpu.train.step import make_train_step
+
+    if mesh is None:
+        mesh = make_mesh_2d(TRAIN_MESH["data"], TRAIN_MESH["seq"])
+    h, w = AUDIT_IMAGE
+    cfg = raft_v1(small=True)
+    tc = TrainConfig(name="shardaudit", stage="chairs", num_steps=10,
+                     batch_size=AUDIT_BATCH, image_size=(h, w),
+                     iters=AUDIT_ITERS)
+    step = make_train_step(cfg, tc, mesh=mesh)
+    state = _audit_state(cfg, tc)
+    sections = _compiled_sections(step, (state, _batch_avals(AUDIT_BATCH,
+                                                             h, w)))
+    return {"mesh": _mesh_dict(mesh), **sections}
+
+
+def _audit_eval_step(mesh) -> Dict[str, Any]:
+    """Shared body for the eval and serve audits — same forward step,
+    different mesh (2-D train mesh vs 1-D serve mesh)."""
+    import numpy as np
+    import jax
+
+    from dexiraft_tpu.config import TrainConfig, raft_v1
+    from dexiraft_tpu.train.step import make_eval_step
+
+    h, w = AUDIT_IMAGE
+    cfg = raft_v1(small=True)
+    step = make_eval_step(cfg, iters=AUDIT_ITERS, mesh=mesh)
+    state = _audit_state(cfg, TrainConfig())
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    im = jax.ShapeDtypeStruct((AUDIT_BATCH, h, w, 3), np.float32)
+    fi = jax.ShapeDtypeStruct((AUDIT_BATCH, h // 8, w // 8, 2), np.float32)
+    sections = _compiled_sections(step, (variables, im, im, None, None, fi))
+    return {"mesh": _mesh_dict(mesh), **sections}
+
+
+def audit_eval(mesh=None) -> Dict[str, Any]:
+    from dexiraft_tpu.parallel.layout import make_mesh_2d
+
+    if mesh is None:
+        mesh = make_mesh_2d(TRAIN_MESH["data"], TRAIN_MESH["seq"])
+    return _audit_eval_step(mesh)
+
+
+def audit_serve(mesh=None) -> Dict[str, Any]:
+    from dexiraft_tpu.parallel.layout import make_serve_mesh
+
+    if mesh is None:
+        mesh = make_serve_mesh(SERVE_MESH["data"])
+    return _audit_eval_step(mesh)
+
+
+def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB
+                    ) -> Dict[str, Any]:
+    """Resolve the layout's declared array groups at the PRODUCTION
+    reference geometry: per-group canonical spec, total bytes, bytes
+    per device, and the replicated-over-threshold flag. This is where
+    the ~200 MB correlation-volume canary lives — it is an intermediate
+    the in/out sections can never see."""
+    from dexiraft_tpu.parallel.layout import (
+        LAYOUT,
+        REPLICATED_OK,
+        make_mesh_2d,
+        spec_str,
+    )
+
+    mesh = make_mesh_2d(TRAIN_MESH["data"], TRAIN_MESH["seq"])
+    h, w = PROD_IMAGE
+    b = PROD_BATCH
+    hw8 = (h // 8) * (w // 8)
+    # (name, spec, total bytes at the reference geometry). Totals are
+    # FULL-BATCH so every axis in the spec genuinely divides its dim —
+    # a per-sample (B=1) total divided by the data axis would understate
+    # the per-device footprint 4x (GSPMD cannot split a size-1 dim).
+    entries = [
+        ("batch", LAYOUT.batch_for(mesh), b * h * w * 3 * 4 * 2),
+        ("carry", LAYOUT.carry(), b * hw8 * 2 * 4),
+        # all-pairs volume: (H/8*W/8)^2 fp32 per sample — ~189 MB at
+        # 440x1024, ~1.5 GB for the batch; THE canary for silent
+        # replication
+        ("corr_volume", LAYOUT.corr_volume(mesh), b * hw8 * hw8 * 4),
+        ("params", LAYOUT.params(), 5_300_000 * 4),
+        ("opt_state", LAYOUT.opt_state(), 2 * 5_300_000 * 4),
+    ]
+    mesh_shape = dict(mesh.shape)
+    out = {}
+    for name, spec, total in entries:
+        shards = 1
+        for entry in tuple(spec):
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax is not None:
+                    shards *= mesh_shape.get(ax, 1)
+        per_device = total // shards
+        replicated = shards == 1
+        flagged = (replicated and per_device > threshold_mb * 2**20
+                   and name not in REPLICATED_OK)
+        out[name] = {
+            "spec": spec_str(spec),
+            "total_mb": round(total / 2**20, 2),
+            "per_device_mb": round(per_device / 2**20, 2),
+            "replicated": replicated,
+            "flagged": flagged,
+        }
+    return out
+
+
+STEP_AUDITS = {"train": audit_train, "eval": audit_eval,
+               "serve": audit_serve}
+
+
+def run_audit(steps: Sequence[str] = ("train", "eval", "serve"),
+              threshold_mb: float = DEFAULT_THRESHOLD_MB) -> Dict[str, Any]:
+    from dexiraft_tpu.parallel.layout import LAYOUT
+
+    report: Dict[str, Any] = {
+        "version": 1,
+        "axes": {"data": LAYOUT.data_axis, "fsdp": LAYOUT.fsdp_axis,
+                 "seq": LAYOUT.seq_axis},
+        "audit_image": list(AUDIT_IMAGE),
+        "audit_batch": AUDIT_BATCH,
+        "steps": {},
+        "declared": declared_groups(threshold_mb),
+    }
+    for name in steps:
+        report["steps"][name] = STEP_AUDITS[name]()
+    return report
+
+
+# --------------------------------------------------------------------------
+# golden diff — pure functions (tested without any compile)
+# --------------------------------------------------------------------------
+
+
+def diff_golden(report: Dict[str, Any], golden: Dict[str, Any]) -> List[str]:
+    """Drift lines between a (possibly partial) report and the golden.
+    Steps absent from the REPORT are not compared (partial --steps
+    runs); steps absent from the GOLDEN are drift."""
+    drift: List[str] = []
+    for key in ("version", "axes", "audit_image", "audit_batch"):
+        if report.get(key) != golden.get(key):
+            drift.append(f"{key}: golden {golden.get(key)!r} != "
+                         f"current {report.get(key)!r}")
+    for step, sec in report.get("steps", {}).items():
+        gsec = golden.get("steps", {}).get(step)
+        if gsec is None:
+            drift.append(f"steps.{step}: not in golden (regenerate with "
+                         f"--write-golden)")
+            continue
+        drift.extend(_diff_section(f"steps.{step}", sec, gsec))
+    # declared groups: specs + replication flags must match exactly
+    for name, cur in report.get("declared", {}).items():
+        gold = golden.get("declared", {}).get(name)
+        if gold is None:
+            drift.append(f"declared.{name}: not in golden")
+            continue
+        for field in ("spec", "replicated", "flagged"):
+            if cur.get(field) != gold.get(field):
+                drift.append(
+                    f"declared.{name}.{field}: golden {gold.get(field)!r} "
+                    f"!= current {cur.get(field)!r}")
+    for name in golden.get("declared", {}):
+        if name not in report.get("declared", {}):
+            drift.append(f"declared.{name}: vanished from the layout")
+    return drift
+
+
+def _diff_section(prefix: str, sec: Dict[str, Any],
+                  gsec: Dict[str, Any]) -> List[str]:
+    drift = []
+    if sec.get("mesh") != gsec.get("mesh"):
+        drift.append(f"{prefix}.mesh: golden {gsec.get('mesh')!r} != "
+                     f"current {sec.get('mesh')!r}")
+    for io in ("in", "out"):
+        cur, gold = sec.get(io, {}), gsec.get(io, {})
+        for group in sorted(set(cur) | set(gold)):
+            c, g = cur.get(group), gold.get(group)
+            if c is None:
+                drift.append(f"{prefix}.{io}.{group}: vanished "
+                             f"(golden specs {g['specs']})")
+            elif g is None:
+                drift.append(f"{prefix}.{io}.{group}: new group with "
+                             f"specs {c['specs']} — regenerate the "
+                             f"golden if intended")
+            elif c["specs"] != g["specs"]:
+                drift.append(f"{prefix}.{io}.{group}: golden specs "
+                             f"{g['specs']} != current {c['specs']}")
+    return drift
+
+
+def flagged_groups(report: Dict[str, Any]) -> List[str]:
+    """Declared groups tripping the replicated-over-threshold wire."""
+    return [f"declared.{name}: {g['total_mb']} MB resolves fully "
+            f"replicated (spec {g['spec']}) — shard it or pin it in "
+            f"parallel.layout.REPLICATED_OK"
+            for name, g in report.get("declared", {}).items()
+            if g.get("flagged")]
+
+
+def load_golden(path: str = GOLDEN_PATH) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def golden_hash(path: str = GOLDEN_PATH) -> str:
+    """sha1 of the golden file's canonical JSON — the provenance stamp
+    dryrun_multichip prints into the MULTICHIP record."""
+    blob = json.dumps(load_golden(path), sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha1(blob).hexdigest()
+
+
+def write_golden(report: Dict[str, Any], path: str = GOLDEN_PATH) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")

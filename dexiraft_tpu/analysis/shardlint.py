@@ -1,0 +1,275 @@
+"""shardlint — jaxlint's sharding-contract rules (JL010+).
+
+PR 5 proved the pattern: the JAX/TPU footguns that erase throughput are
+textual, so a pure-stdlib AST pass can fail the commit instead of a
+bench failing the quarter. This module extends that machinery from
+"JAX footguns" to "sharding contracts": the canonical layout
+(``parallel/layout.py``) is the single source of truth for mesh axis
+names and PartitionSpecs, and these rules make that a property of the
+tree rather than a convention. Spec drift, ad-hoc mesh axes, and
+unpinned mesh-path jits become CI failures before they become
+silently-replicated multi-hundred-MB arrays on a pod.
+
+Rule catalog (docs/static_analysis.md has the long-form version):
+
+  JL010 inline-spec        PartitionSpec / NamedSharding constructed
+                           outside parallel/layout.py — every spec must
+                           be drawn from the frozen SpecLayout, or the
+                           shard audit's golden can no longer account
+                           for it.
+  JL011 adhoc-mesh-axis    a Mesh (or mesh_utils/jax.make_mesh)
+                           constructed outside parallel/layout.py, or a
+                           mesh-axis-name STRING literal ('data' /
+                           'fsdp' / 'seq') passed to a sharding or
+                           collective API — axis names come from the
+                           layout's constants, never re-spelled.
+  JL012 raw-spec-constraint with_sharding_constraint called with an
+                           inline spec literal — constraints must name
+                           a layout spec so the audit can diff them.
+  JL013 unpinned-mesh-jit  inside a mesh-parameterized step builder, a
+                           jit over a state/variables-threading fn
+                           without BOTH in_shardings and out_shardings
+                           (the `if mesh is None` single-chip branch is
+                           the one sanctioned unpinned form) — an
+                           unpinned mesh-path jit lets GSPMD infer
+                           layouts the golden never sees.
+
+This module is pure stdlib and is loaded BY ``jaxlint.py`` (by file
+path, like lint_gate loads jaxlint itself): jaxlint merges RULES and
+calls :func:`run_rules` from its linter, so the gate, the baseline
+allowlist, and ``# jaxlint: disable=JL01X`` suppression all work
+unchanged for these rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+RULES = {
+    "JL010": "inline-spec",
+    "JL011": "adhoc-mesh-axis",
+    "JL012": "raw-spec-constraint",
+    "JL013": "unpinned-mesh-jit",
+}
+
+#: The one module allowed to construct sharding objects.
+LAYOUT_PATH = "dexiraft_tpu/parallel/layout.py"
+
+#: Mirror of SpecLayout's axis names (parallel/layout.py). shardlint
+#: must stay jax-free, so the names are pinned here and a test asserts
+#: they equal the live layout's axes (tests/test_zzzshardlayout.py).
+LAYOUT_AXES = frozenset({"data", "fsdp", "seq"})
+
+# dotted names (post alias-resolution) that construct specs / meshes
+_SPEC_CTORS = {
+    "jax.sharding.PartitionSpec", "PartitionSpec",
+    "jax.sharding.NamedSharding", "NamedSharding",
+}
+_MESH_CTORS = {
+    "jax.sharding.Mesh", "Mesh", "jax.make_mesh",
+    "jax.experimental.mesh_utils.create_device_mesh",
+    "mesh_utils.create_device_mesh",
+}
+_CONSTRAINT_FNS = {
+    "jax.lax.with_sharding_constraint", "with_sharding_constraint",
+    "jax.experimental.pjit.with_sharding_constraint",
+}
+# collective/sharding APIs whose string args are axis names (JL011's
+# second half); matched on the resolved dotted name OR the final attr
+_AXIS_API_ATTRS = {
+    "axis_index", "ppermute", "psum", "pmean", "pmax", "pmin",
+    "all_gather", "all_to_all", "axis_size", "pshuffle",
+}
+_AXIS_KEYWORDS = {"axis", "axis_name", "axis_names", "mesh_axes"}
+# leading-parameter names that mark a jitted fn as threading sharded
+# state through a mesh-parameterized builder (superset of jaxlint's
+# _STATE_PARAMS: eval/serve steps thread `variables`)
+_STATE_LIKE = {"state", "train_state", "opt_state", "carry",
+               "variables", "params"}
+
+
+def _is_layout(path: str) -> bool:
+    return path.replace("\\", "/") == LAYOUT_PATH
+
+
+def _spec_ctor(linter, node: ast.AST) -> Optional[str]:
+    """Resolved spec-constructor name if `node` is a PartitionSpec /
+    NamedSharding call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    callee = linter.mod.dotted(node.func)
+    return callee if callee in _SPEC_CTORS else None
+
+
+# --------------------------------------------------------------------------
+# JL010 / JL011 / JL012 — whole-module scans
+# --------------------------------------------------------------------------
+
+
+def _rule_jl010(linter) -> None:
+    if _is_layout(linter.mod.path):
+        return
+    for node in ast.walk(linter.mod.tree):
+        callee = _spec_ctor(linter, node)
+        if callee:
+            linter.flag(
+                "JL010", node,
+                f"{callee.split('.')[-1]}(...) constructed outside "
+                f"{LAYOUT_PATH} — draw the spec from the frozen "
+                f"SpecLayout (parallel.layout.LAYOUT) so the shard "
+                f"audit's golden accounts for it")
+
+
+def _rule_jl011(linter) -> None:
+    if _is_layout(linter.mod.path):
+        return
+    for node in ast.walk(linter.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = linter.mod.dotted(node.func) or ""
+        if callee in _MESH_CTORS:
+            linter.flag(
+                "JL011", node,
+                f"{callee.split('.')[-1]}(...) constructed outside "
+                f"{LAYOUT_PATH} — mesh construction belongs to the "
+                f"layout (make_mesh/make_mesh_2d/make_serve_mesh/"
+                f"make_train_mesh)")
+            continue
+        # axis-name string literal fed to a sharding/collective API.
+        # _SPEC_CTORS are deliberately NOT in this set: an inline
+        # PartitionSpec('data') is ONE defect and JL010 already owns
+        # it — double-flagging would demand two suppressions per line
+        is_axis_api = (
+            callee in _MESH_CTORS
+            or callee in ("jax.shard_map", "shard_map",
+                          "jax.experimental.shard_map.shard_map")
+            or (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _AXIS_API_ATTRS)
+            or callee.split(".")[-1] in _AXIS_API_ATTRS)
+        for arg in node.args:
+            if is_axis_api:
+                _flag_axis_strings(linter, arg)
+        for kw in node.keywords:
+            if is_axis_api or kw.arg in _AXIS_KEYWORDS:
+                _flag_axis_strings(linter, kw.value)
+
+
+def _flag_axis_strings(linter, node: ast.AST) -> None:
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and sub.value in LAYOUT_AXES):
+            linter.flag(
+                "JL011", sub,
+                f"mesh-axis name {sub.value!r} spelled as a string "
+                f"literal — use the layout's constants "
+                f"(parallel.layout.LAYOUT.{sub.value}_axis / "
+                f"DATA_AXIS/SEQ_AXIS/FSDP_AXIS)")
+
+
+def _rule_jl012(linter) -> None:
+    for node in ast.walk(linter.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if linter.mod.dotted(node.func) not in _CONSTRAINT_FNS:
+            continue
+        raw_args = list(node.args[1:]) + [k.value for k in node.keywords]
+        for arg in raw_args:
+            for sub in ast.walk(arg):
+                if _spec_ctor(linter, sub):
+                    linter.flag(
+                        "JL012", node,
+                        "with_sharding_constraint with an inline spec "
+                        "literal — name a layout spec "
+                        "(parallel.layout.LAYOUT / named(mesh, ...)) "
+                        "so the constraint participates in the audit "
+                        "golden")
+                    break
+
+
+# --------------------------------------------------------------------------
+# JL013 — unpinned jit on the mesh path of a step builder
+# --------------------------------------------------------------------------
+
+
+def _mesh_none_spans(fn: ast.AST) -> List[Tuple[int, int]]:
+    """Line spans of `if mesh is None:` bodies inside fn — the one
+    sanctioned place for an unpinned state-threading jit."""
+    spans = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        if (isinstance(t, ast.Compare) and isinstance(t.left, ast.Name)
+                and t.left.id == "mesh" and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Is)
+                and len(t.comparators) == 1
+                and isinstance(t.comparators[0], ast.Constant)
+                and t.comparators[0].value is None):
+            start = node.body[0].lineno
+            end = max(getattr(s, "end_lineno", s.lineno)
+                      for s in node.body)
+            spans.append((start, end))
+    return spans
+
+
+def _jit_wrapped_leading_param(local_defs, call: ast.Call) -> Optional[str]:
+    """Leading positional param name of the fn a jit call wraps, resolved
+    against the ENCLOSING builder's own defs (module-level resolution
+    would collide: every builder names its inner fn `step`)."""
+    if not call.args:
+        return None
+    wrapped = call.args[0]
+    fn = None
+    if isinstance(wrapped, ast.Name):
+        fn = local_defs.get(wrapped.id)
+    elif isinstance(wrapped, ast.Lambda):
+        fn = wrapped
+    if fn is None:
+        return None
+    a = fn.args
+    ordered = [p.arg for p in a.posonlyargs + a.args if p.arg != "self"]
+    return ordered[0] if ordered else None
+
+
+def _rule_jl013(linter) -> None:
+    for fn in ast.walk(linter.mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = fn.args
+        param_names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if "mesh" not in param_names:
+            continue
+        exempt = _mesh_none_spans(fn)
+        local_defs = {d.name: d for d in ast.walk(fn)
+                      if isinstance(d, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not linter.mod._is_jit(node.func):
+                continue
+            leading = _jit_wrapped_leading_param(local_defs, node)
+            if leading not in _STATE_LIKE:
+                continue
+            kwargs = {k.arg for k in node.keywords if k.arg}
+            if {"in_shardings", "out_shardings"} <= kwargs:
+                continue
+            if any(s <= node.lineno <= e for s, e in exempt):
+                continue  # the single-chip branch
+            missing = sorted({"in_shardings", "out_shardings"} - kwargs)
+            linter.flag(
+                "JL013", node,
+                f"jit over state-threading fn (leading param "
+                f"{leading!r}) in a mesh-parameterized builder without "
+                f"{'/'.join(missing)} — pin the layout's shardings on "
+                f"the mesh path (unpinned jit is only sanctioned "
+                f"inside `if mesh is None`)")
+
+
+def run_rules(linter) -> None:
+    """Entry point jaxlint's _Linter calls; duck-typed on (mod, flag)."""
+    _rule_jl010(linter)
+    _rule_jl011(linter)
+    _rule_jl012(linter)
+    _rule_jl013(linter)

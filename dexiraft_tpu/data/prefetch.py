@@ -81,7 +81,7 @@ class DevicePrefetcher:
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
         if put is None:
-            from dexiraft_tpu.parallel.mesh import batch_putter
+            from dexiraft_tpu.parallel.layout import batch_putter
 
             put = batch_putter(None)
         self.put = put
@@ -168,8 +168,8 @@ def prefetch_to_device(
     pipeline_stats=None,
 ) -> DevicePrefetcher:
     """Convenience wrapper: prefetch with the train step's input layout
-    for `mesh` (parallel.mesh.batch_putter; plain device_put when None)."""
-    from dexiraft_tpu.parallel.mesh import batch_putter
+    for `mesh` (parallel.layout.batch_putter; plain device_put when None)."""
+    from dexiraft_tpu.parallel.layout import batch_putter
 
     return DevicePrefetcher(iterable, batch_putter(mesh), depth=depth,
                             pipeline_stats=pipeline_stats)

@@ -131,7 +131,7 @@ def _make_eval_fn(args, cfg, variables, iters):
 
     mesh = None
     if args.data_parallel > 0:
-        from dexiraft_tpu.parallel.mesh import make_serve_mesh, replicate
+        from dexiraft_tpu.parallel.layout import make_serve_mesh, replicate
 
         mesh = make_serve_mesh(args.data_parallel)
         # replicate once up front — the pinned replicated in_sharding

@@ -3,12 +3,20 @@
 The reference's entire parallelism story is single-process
 torch.nn.DataParallel (train.py:139, SURVEY.md §2.7). The TPU-native
 equivalent is declarative: build a jax.sharding.Mesh over the chips,
-shard the batch over the 'data' axis, replicate parameters, and let the
-SPMD partitioner insert the gradient all-reduce over ICI.
+shard the batch over the layout's data axis, replicate parameters, and
+let the SPMD partitioner insert the gradient all-reduce over ICI.
+
+``parallel.layout`` is the single source of truth: the frozen
+:class:`SpecLayout` owns every mesh axis name and canonical
+PartitionSpec (docs/parallel.md), enforced statically by the jaxlint
+sharding rules (JL010+) and dynamically by ``analysis/shardaudit.py``'s
+golden diff. ``parallel.mesh`` remains as the compat import path.
 """
 
-from dexiraft_tpu.parallel.mesh import (
+from dexiraft_tpu.parallel.layout import (
     DATA_AXIS,
+    LAYOUT,
+    SpecLayout,
     batch_sharding,
     make_mesh,
     replicated_sharding,
@@ -17,6 +25,8 @@ from dexiraft_tpu.parallel.mesh import (
 
 __all__ = [
     "DATA_AXIS",
+    "LAYOUT",
+    "SpecLayout",
     "batch_sharding",
     "make_mesh",
     "replicated_sharding",

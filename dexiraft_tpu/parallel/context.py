@@ -24,11 +24,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 from jax import shard_map
 
 from dexiraft_tpu.ops.corr import build_corr_pyramid, corr_lookup
-from dexiraft_tpu.parallel.mesh import SEQ_AXIS
+from dexiraft_tpu.parallel.layout import LAYOUT, SEQ_AXIS
 
 
 def context_parallel_corr(
@@ -49,12 +49,13 @@ def context_parallel_corr(
 
     Returns (B, H, W, num_levels * (2r+1)^2), sharded like the inputs.
     """
-    if SEQ_AXIS not in mesh.axis_names:
+    if not LAYOUT.has_seq(mesh):
         raise ValueError(f"mesh has no '{SEQ_AXIS}' axis: {mesh.axis_names}")
-    q_spec = P(None, SEQ_AXIS, None, None)
+    q_spec = LAYOUT.corr_query_rows()
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(q_spec, P(), q_spec), out_specs=q_spec)
+             in_specs=(q_spec, LAYOUT.replicated(), q_spec),
+             out_specs=q_spec)
     def _lookup(f1_loc, f2_full, coords_loc):
         pyr = build_corr_pyramid(f1_loc, f2_full, num_levels, radius)
         return corr_lookup(pyr, coords_loc)
@@ -93,7 +94,7 @@ def ring_corr_lookup(
 
     Returns (B, H, W, num_levels * (2r+1)^2), sharded like the inputs.
     """
-    if SEQ_AXIS not in mesh.axis_names:
+    if not LAYOUT.has_seq(mesh):
         raise ValueError(f"mesh has no '{SEQ_AXIS}' axis: {mesh.axis_names}")
     n_seq = mesh.shape[SEQ_AXIS]
     h = fmap1.shape[1]
@@ -101,7 +102,7 @@ def ring_corr_lookup(
         raise ValueError(
             f"H={h} must be divisible by n_seq={n_seq} with blocks "
             f"divisible by 2^{num_levels - 1} for pooling alignment")
-    q_spec = P(None, SEQ_AXIS, None, None)
+    q_spec = LAYOUT.corr_query_rows()
     fwd = [(i, (i + 1) % n_seq) for i in range(n_seq)]
 
     from dexiraft_tpu.ops.corr import (

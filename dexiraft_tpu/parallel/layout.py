@@ -1,0 +1,346 @@
+"""Canonical sharding layout — the single source of truth for GSPMD.
+
+Every mesh axis name, every ``PartitionSpec``, and every
+``Mesh``/``NamedSharding`` construction in this codebase lives HERE and
+only here. The static gate enforces it: jaxlint rules JL010+ (see
+``analysis/shardlint.py``) fail the commit on any inline spec literal,
+ad-hoc mesh-axis string, or unpinned mesh-path jit outside this module,
+and ``analysis/shardaudit.py`` diffs the compiled train/eval/serve
+steps' resolved shardings against ``analysis/layout_golden.json`` so
+spec drift is a CI failure, not a pod-debugging session.
+
+Why one frozen layout object: the sharding story grew organically
+(mesh.py helpers, per-CLI glue, context.py shard_map specs) and the
+ROADMAP's pod-scale item is blocked on collapsing it — the SNIPPETS.md
+exemplar ("8-chip v4 to 6000-chip v5p without changing application
+code") is a frozen ``SpecLayout`` dataclass exactly like this one.
+Application code asks the layout for *meaning* ("the batch's sharding
+on this mesh"), never spells axes.
+
+Axes (``SpecLayout``):
+
+  data  — batch data-parallelism. Every mesh has it; gradients
+          all-reduce over it (the SPMD partitioner inserts the psum).
+  seq   — context parallelism: image rows (and with them the quadratic
+          correlation volume's query axis) shard over it on 2-D train
+          meshes (parallel/context.py has the math).
+  fsdp  — RESERVED for pod-scale parameter sharding. No current mesh
+          instantiates it; params/optimizer state replicate today
+          (declared in ``REPLICATED_OK`` so the audit's
+          large-replicated-array tripwire exempts them knowingly).
+          When a mesh grows the axis, ``fsdp_params()`` is the one
+          place the param spec changes.
+
+The compat surface ``parallel/mesh.py`` re-exports everything below, so
+existing imports keep working; new code should import from here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+# jax import kept function-local where possible is NOT viable here: the
+# module's whole job is constructing jax.sharding objects. Callers that
+# must stay jax-free (data/__init__, loaders) already import lazily.
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Frozen mesh-axis names + canonical PartitionSpecs.
+
+    Methods return ``PartitionSpec``s (mesh-independent); pair them with
+    a mesh via :func:`named`. Specs that depend on the mesh's rank
+    (batch, correlation volume) take the mesh and pick the 1-D or 2-D
+    form — call sites never branch on axis names themselves.
+    """
+
+    data_axis: str = "data"
+    fsdp_axis: str = "fsdp"
+    seq_axis: str = "seq"
+
+    # ---- mesh-independent specs ---------------------------------------
+
+    def replicated(self) -> PartitionSpec:
+        """Fully replicated: params, optimizer state, scalars, metrics."""
+        return PartitionSpec()
+
+    def params(self) -> PartitionSpec:
+        """Model parameters. Replicated today (no fsdp mesh yet) —
+        listed in REPLICATED_OK so the audit accepts it knowingly."""
+        return PartitionSpec()
+
+    def opt_state(self) -> PartitionSpec:
+        """Optimizer state mirrors the param layout."""
+        return self.params()
+
+    def fsdp_params(self) -> PartitionSpec:
+        """Pod-scale param spec: leading dim sharded over 'fsdp'. No
+        current mesh has the axis; this is the declared migration
+        target, not a live spec (the audit golden pins params
+        replicated until a mesh instantiates fsdp)."""
+        return PartitionSpec(self.fsdp_axis)
+
+    def batch(self) -> PartitionSpec:
+        """Batch leaves on a 1-D mesh: leading (batch) dim over 'data'."""
+        return PartitionSpec(self.data_axis)
+
+    def batch_spatial(self) -> PartitionSpec:
+        """Batch leaves on a 2-D (data, seq) mesh: batch over 'data' AND
+        image rows over 'seq' — GSPMD partitions convolutions with halo
+        exchange and the correlation volume by query rows."""
+        return PartitionSpec(self.data_axis, self.seq_axis)
+
+    def carry(self) -> PartitionSpec:
+        """Flow/carry state (flow_init, flow_low — (B, H/8, W/8, 2)):
+        batch-sharded like the frames it warm-starts."""
+        return PartitionSpec(self.data_axis)
+
+    def corr_query_rows(self) -> PartitionSpec:
+        """shard_map spec for explicit context parallelism
+        (parallel/context.py): (B, H, W, D) feature maps / coords with
+        H (the volume's query axis) over 'seq', everything else local."""
+        return PartitionSpec(None, self.seq_axis, None, None)
+
+    # ---- mesh-dependent specs -----------------------------------------
+
+    def batch_for(self, mesh: Mesh) -> PartitionSpec:
+        """THE batch spec for a given mesh: spatial (data, seq) when the
+        mesh has a seq axis, else batch-only. Shared by the train step's
+        in_shardings and the device prefetcher's put, so a prefetched
+        batch lands already in the step's input layout. Contract: one
+        spec for the whole batch dict, so every batch leaf must be
+        >=3-D (B, H, ...) on a 2-D mesh — true for image1/2, flow,
+        valid, edges; a future <3-D leaf needs per-leaf specs here AND
+        in batch_putter (shard_batch_spatial already splits by ndim on
+        the put side)."""
+        return (self.batch_spatial() if self.seq_axis in mesh.axis_names
+                else self.batch())
+
+    def corr_volume(self, mesh: Mesh) -> PartitionSpec:
+        """The ~200 MB all-pairs correlation volume (B, H, W, H*W) — the
+        audit's canary array: batch over 'data', query rows over 'seq'
+        when the mesh has the axis. Fully replicating this one is the
+        exact failure the size tripwire exists for."""
+        return self.batch_for(mesh)
+
+    # ---- mesh shape queries -------------------------------------------
+
+    def data_size(self, mesh: Mesh) -> int:
+        """Number of ways the batch splits on this mesh's data axis."""
+        return dict(mesh.shape).get(self.data_axis, 1)
+
+    def has_seq(self, mesh: Mesh) -> bool:
+        return self.seq_axis in mesh.axis_names
+
+
+#: The one layout instance application code threads around.
+LAYOUT = SpecLayout()
+
+#: Logical array groups the shard audit may see fully replicated without
+#: flagging, with the reason pinned next to the exemption.
+REPLICATED_OK = {
+    "params": "replicated by design until a mesh instantiates 'fsdp'",
+    "opt_state": "mirrors the param layout (see params)",
+    "batch_stats": "BatchNorm running stats are global (sync-BN)",
+    "rng": "scalar-sized PRNG key",
+    "step": "scalar step counter",
+    "metrics": "scalar loss/metric outputs",
+}
+
+# legacy axis-name constants (parallel/mesh.py re-exports them); new
+# code should take names from LAYOUT
+DATA_AXIS = LAYOUT.data_axis
+SEQ_AXIS = LAYOUT.seq_axis
+FSDP_AXIS = LAYOUT.fsdp_axis
+
+
+def named(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    """The ONE NamedSharding constructor (JL010 bans inline ones)."""
+    return NamedSharding(mesh, spec)
+
+
+# --------------------------------------------------------------------------
+# mesh constructors — the only Mesh() call sites in the tree (JL011)
+# --------------------------------------------------------------------------
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              axis: Optional[str] = None) -> Mesh:
+    """1-D data mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis or LAYOUT.data_axis,))
+
+
+def make_mesh_2d(
+    n_data: int,
+    n_seq: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(data, seq) mesh: batch DP x spatial/sequence CP.
+
+    The seq axis shards image rows (and with them the quadratic
+    correlation volume's query axis — see parallel.context). Keep seq
+    groups on adjacent devices so the fmap2 all-gather rides ICI
+    neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_data * n_seq > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_seq} needs {n_data * n_seq} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[: n_data * n_seq]).reshape(n_data, n_seq)
+    return Mesh(grid, (LAYOUT.data_axis, LAYOUT.seq_axis))
+
+
+def make_train_mesh(batch_size: int,
+                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The training CLI's mesh policy (was inline glue in train_cli):
+    a 1-D data mesh over the largest device count that divides the
+    batch — a 10-batch on 8 chips uses 2; pick batch sizes that are
+    multiples of the slice size to use every chip."""
+    if devices is None:
+        devices = jax.devices()
+    n_use = max(n for n in range(1, len(devices) + 1)
+                if batch_size % n == 0)
+    return make_mesh(devices[:n_use])
+
+
+def make_serve_mesh(n_chips: Optional[int] = None) -> Mesh:
+    """1-D data mesh for the serving engine (dexiraft_tpu.serve): an
+    inference batch shards over the 'data' axis across `n_chips` (default
+    all). Serving never needs the 2-D (data, seq) train mesh — eval
+    batches are the parallelism, not image rows."""
+    devices = jax.devices()
+    if n_chips is not None:
+        if not 1 <= n_chips <= len(devices):
+            raise ValueError(
+                f"n_chips {n_chips} out of range 1..{len(devices)}")
+        devices = devices[:n_chips]
+    return make_mesh(devices)
+
+
+# --------------------------------------------------------------------------
+# shardings for a concrete mesh
+# --------------------------------------------------------------------------
+
+
+def batch_sharding(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    if axis is not None and axis != LAYOUT.data_axis:
+        # explicit non-canonical axis: honored, but the layout owns the
+        # PartitionSpec construction
+        return named(mesh, PartitionSpec(axis))
+    return named(mesh, LAYOUT.batch())
+
+
+def spatial_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch over 'data' AND image rows over 'seq' (context parallelism):
+    GSPMD partitions convolutions with halo exchange and the correlation
+    volume by query rows under this annotation."""
+    return named(mesh, LAYOUT.batch_spatial())
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (parameters, optimizer state, scalars)."""
+    return named(mesh, LAYOUT.replicated())
+
+
+def batch_input_sharding(mesh: Mesh) -> NamedSharding:
+    """The sharding the jitted train step pins its batch argument to —
+    LAYOUT.batch_for(mesh) as a NamedSharding. Shared by train.step and
+    the device prefetcher, so a prefetched batch lands ALREADY in the
+    step's input layout and consuming it triggers no resharding copy."""
+    return named(mesh, LAYOUT.batch_for(mesh))
+
+
+def carry_sharding(mesh: Mesh) -> NamedSharding:
+    """Warm-start carry (flow_init / flow_low) sharding."""
+    return named(mesh, LAYOUT.carry())
+
+
+# --------------------------------------------------------------------------
+# host -> device placement
+# --------------------------------------------------------------------------
+
+
+def _put(x: Any, sharding: NamedSharding) -> jax.Array:
+    """Host array -> global sharded array.
+
+    Single-process: plain device_put. Multi-process: the host holds only
+    its jax.process_index() slice of the global batch (Loader slices at
+    decode time), so assemble the global array from per-process locals —
+    the multi-host analog of DataParallel's scatter."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis: Optional[str] = None) -> Any:
+    """Device-put every leaf of a host batch with its leading dim sharded.
+
+    The per-host analog of DataParallel's scatter (but zero-copy once the
+    arrays are on device; donation happens in the jitted step). In a
+    multi-process run each host contributes its local Loader slice and
+    the result is the global batch.
+    """
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda x: _put(x, sharding), batch)
+
+
+def shard_batch_spatial(batch: Any, mesh: Mesh) -> Any:
+    """device_put a host batch with (data, seq) sharding: 3D/4D image-like
+    leaves shard over (batch, rows); everything else batch-only."""
+    sp = spatial_sharding(mesh)
+    bo = batch_sharding(mesh)
+    return jax.tree.map(
+        lambda x: _put(x, sp if np.ndim(x) >= 3 else bo), batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Device-put every leaf of a pytree fully replicated over the mesh.
+
+    Needed explicitly in multi-process runs: host-local state (e.g. from
+    create_state, identical on every process by construction) must become
+    global replicated arrays before a pjitted step can consume it."""
+    repl = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: _put(x, repl), tree)
+
+
+def batch_putter(mesh: Optional[Mesh]):
+    """batch -> on-device batch, in the train step's input layout.
+
+    The transfer-side helper for data.prefetch.DevicePrefetcher: returns
+    a callable that device_puts a host batch dict with the SAME shardings
+    make_train_step pins via in_shardings (batch_input_sharding above —
+    same >=3-D-leaf contract on a 2-D mesh). jax.device_put is
+    asynchronous, so the returned callable only ENQUEUES the
+    host->device copy — the prefetcher keeps several in flight while
+    the current step computes. mesh=None: plain device_put to the
+    default device (single-chip)."""
+    if mesh is None:
+        return lambda batch: jax.tree.map(jax.device_put, batch)
+    if LAYOUT.has_seq(mesh):
+        return lambda batch: shard_batch_spatial(batch, mesh)
+    return lambda batch: shard_batch(batch, mesh)
+
+
+def spec_str(spec: PartitionSpec) -> str:
+    """Stable, human-diffable serialization of a PartitionSpec — the
+    representation layout_golden.json pins ("P()", "P('data', 'seq')",
+    "P(None, 'seq', None, None)")."""
+    parts = []
+    for entry in tuple(spec):
+        if entry is None:
+            parts.append("None")
+        elif isinstance(entry, tuple):
+            parts.append("(" + ", ".join(repr(e) for e in entry) + ")")
+        else:
+            parts.append(repr(entry))
+    return "P(" + ", ".join(parts) + ")"

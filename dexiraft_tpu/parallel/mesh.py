@@ -1,158 +1,57 @@
-"""1-D data-parallel mesh over all chips.
+"""Compat surface over the canonical sharding layout.
 
-Replaces torch.nn.DataParallel's replicate/scatter/gather (train.py:139)
-with a jax.sharding.Mesh: batch arrays are sharded over the 'data' axis,
-parameters are replicated, and XLA's SPMD partitioner inserts the
-gradient all-reduce (psum over ICI) during autodiff of the sharded
-computation — no imperative communication code at all.
-
-Multi-host: jax.devices() already enumerates every chip in the slice, so
-the same mesh spans hosts; DCN axes would only be needed for multi-slice
-(not required for parity, SURVEY.md §2.7).
+Everything here now lives in — and is re-exported from —
+``parallel/layout.py``: the frozen :class:`~dexiraft_tpu.parallel.layout.
+SpecLayout` is the single source of truth for mesh axis names and
+PartitionSpecs, and the jaxlint sharding rules (JL010+) ban constructing
+``Mesh``/``NamedSharding``/``PartitionSpec`` anywhere else. This module
+keeps the historical import path working for tests and older call
+sites; new code imports from ``dexiraft_tpu.parallel.layout``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from dexiraft_tpu.parallel.layout import (  # noqa: F401
+    DATA_AXIS,
+    FSDP_AXIS,
+    LAYOUT,
+    SEQ_AXIS,
+    SpecLayout,
+    _put,
+    batch_input_sharding,
+    batch_putter,
+    batch_sharding,
+    carry_sharding,
+    make_mesh,
+    make_mesh_2d,
+    make_serve_mesh,
+    make_train_mesh,
+    named,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+    shard_batch_spatial,
+    spatial_sharding,
+)
 
-import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-DATA_AXIS = "data"
-SEQ_AXIS = "seq"
-
-
-def make_mesh(devices: Optional[Sequence[jax.Device]] = None, axis: str = DATA_AXIS) -> Mesh:
-    """1-D mesh over the given (default: all) devices."""
-    if devices is None:
-        devices = jax.devices()
-    return Mesh(np.asarray(devices), (axis,))
-
-
-def make_mesh_2d(
-    n_data: int,
-    n_seq: int,
-    devices: Optional[Sequence[jax.Device]] = None,
-) -> Mesh:
-    """(data, seq) mesh: batch DP x spatial/sequence CP.
-
-    The seq axis shards image rows (and with them the quadratic
-    correlation volume's query axis — see parallel.context). Keep seq
-    groups on adjacent devices so the fmap2 all-gather rides ICI
-    neighbors.
-    """
-    if devices is None:
-        devices = jax.devices()
-    if n_data * n_seq > len(devices):
-        raise ValueError(
-            f"mesh {n_data}x{n_seq} needs {n_data * n_seq} devices, "
-            f"have {len(devices)}")
-    grid = np.asarray(devices[: n_data * n_seq]).reshape(n_data, n_seq)
-    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
-
-
-def make_serve_mesh(n_chips: Optional[int] = None) -> Mesh:
-    """1-D data mesh for the serving engine (dexiraft_tpu.serve): an
-    inference batch shards over the 'data' axis across `n_chips` (default
-    all). Serving never needs the 2-D (data, seq) train mesh — eval
-    batches are the parallelism, not image rows."""
-    devices = jax.devices()
-    if n_chips is not None:
-        if not 1 <= n_chips <= len(devices):
-            raise ValueError(
-                f"n_chips {n_chips} out of range 1..{len(devices)}")
-        devices = devices[:n_chips]
-    return make_mesh(devices)
-
-
-def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
-    """Shard the leading (batch) dim over the data axis."""
-    return NamedSharding(mesh, P(axis))
-
-
-def spatial_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch over 'data' AND image rows over 'seq' (context parallelism):
-    GSPMD partitions convolutions with halo exchange and the correlation
-    volume by query rows under this annotation."""
-    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
-
-
-def _put(x: Any, sharding: NamedSharding) -> jax.Array:
-    """Host array -> global sharded array.
-
-    Single-process: plain device_put. Multi-process: the host holds only
-    its jax.process_index() slice of the global batch (Loader slices at
-    decode time), so assemble the global array from per-process locals —
-    the multi-host analog of DataParallel's scatter."""
-    if jax.process_count() == 1:
-        return jax.device_put(x, sharding)
-    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
-
-
-def shard_batch_spatial(batch: Any, mesh: Mesh) -> Any:
-    """device_put a host batch with (data, seq) sharding: 3D/4D image-like
-    leaves shard over (batch, rows); everything else batch-only."""
-    sp = spatial_sharding(mesh)
-    bo = batch_sharding(mesh)
-    return jax.tree.map(
-        lambda x: _put(x, sp if np.ndim(x) >= 3 else bo), batch)
-
-
-def batch_input_sharding(mesh: Mesh) -> NamedSharding:
-    """The sharding the jitted train step pins its batch argument to:
-    (data, seq) spatial when the mesh has a seq axis, else batch-only.
-    Shared by train.step and the device prefetcher — a prefetched batch
-    lands ALREADY in the step's input layout, so consuming it triggers
-    no resharding copy. Contract: one spec for the whole batch dict, so
-    every batch leaf must be >=3-D (B, H, ...) on a 2-D mesh — true for
-    image1/2, flow, valid, edges; a future <3-D leaf needs per-leaf
-    specs here AND in batch_putter (shard_batch_spatial already splits
-    by ndim on the put side)."""
-    return (spatial_sharding(mesh) if SEQ_AXIS in mesh.axis_names
-            else batch_sharding(mesh))
-
-
-def batch_putter(mesh: Optional[Mesh]):
-    """batch -> on-device batch, in the train step's input layout.
-
-    The transfer-side helper for data.prefetch.DevicePrefetcher: returns
-    a callable that device_puts a host batch dict with the SAME shardings
-    make_train_step pins via in_shardings (batch_input_sharding above —
-    same >=3-D-leaf contract on a 2-D mesh). jax.device_put is
-    asynchronous, so the returned callable only ENQUEUES the
-    host->device copy — the prefetcher keeps several in flight while
-    the current step computes. mesh=None: plain device_put to the
-    default device (single-chip)."""
-    if mesh is None:
-        return lambda batch: jax.tree.map(jax.device_put, batch)
-    if SEQ_AXIS in mesh.axis_names:
-        return lambda batch: shard_batch_spatial(batch, mesh)
-    return lambda batch: shard_batch(batch, mesh)
-
-
-def replicated_sharding(mesh: Mesh) -> NamedSharding:
-    """Fully replicated (parameters, optimizer state, scalars)."""
-    return NamedSharding(mesh, P())
-
-
-def replicate(tree: Any, mesh: Mesh) -> Any:
-    """Device-put every leaf of a pytree fully replicated over the mesh.
-
-    Needed explicitly in multi-process runs: host-local state (e.g. from
-    create_state, identical on every process by construction) must become
-    global replicated arrays before a pjitted step can consume it."""
-    repl = replicated_sharding(mesh)
-    return jax.tree.map(lambda x: _put(x, repl), tree)
-
-
-def shard_batch(batch: Any, mesh: Mesh, axis: str = DATA_AXIS) -> Any:
-    """Device-put every leaf of a host batch with its leading dim sharded.
-
-    The per-host analog of DataParallel's scatter (but zero-copy once the
-    arrays are on device; donation happens in the jitted step). In a
-    multi-process run each host contributes its local Loader slice and
-    the result is the global batch.
-    """
-    sharding = batch_sharding(mesh, axis)
-    return jax.tree.map(lambda x: _put(x, sharding), batch)
+__all__ = [
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "LAYOUT",
+    "SEQ_AXIS",
+    "SpecLayout",
+    "batch_input_sharding",
+    "batch_putter",
+    "batch_sharding",
+    "carry_sharding",
+    "make_mesh",
+    "make_mesh_2d",
+    "make_serve_mesh",
+    "make_train_mesh",
+    "named",
+    "replicate",
+    "replicated_sharding",
+    "shard_batch",
+    "shard_batch_spatial",
+    "spatial_sharding",
+]

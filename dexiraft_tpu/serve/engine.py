@@ -164,7 +164,7 @@ class InferenceEngine:
                     f"mesh's {n_data} devices — every chip needs a full "
                     f"shard of each dispatched batch")
         if put is None:
-            from dexiraft_tpu.parallel.mesh import batch_putter
+            from dexiraft_tpu.parallel.layout import batch_putter
 
             put = batch_putter(mesh)
         self.put = put

@@ -25,8 +25,8 @@ from jax.sharding import Mesh
 from dexiraft_tpu.config import RAFTConfig, TrainConfig
 from dexiraft_tpu.models.raft import RAFT
 from dexiraft_tpu.ops.losses import sequence_loss
-from dexiraft_tpu.parallel.mesh import (
-    DATA_AXIS,
+from dexiraft_tpu.parallel.layout import (
+    LAYOUT,
     batch_input_sharding,
     replicated_sharding,
 )
@@ -178,7 +178,7 @@ def make_train_step(
                 # each microbatch must still split over the data axis,
                 # or GSPMD reshards / idles chips on EVERY scan
                 # iteration — the opposite of what accumulation buys
-                n_data = dict(mesh.shape).get(DATA_AXIS, 1)
+                n_data = LAYOUT.data_size(mesh)
                 if (b // accum) % n_data:
                     raise ValueError(
                         f"microbatch {b // accum} (batch {b} / accum "

@@ -269,7 +269,7 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     from dexiraft_tpu.data.datasets import fetch_dataset
     from dexiraft_tpu.data.loader import Loader
     from dexiraft_tpu.data.prefetch import prefetch_to_device
-    from dexiraft_tpu.parallel.mesh import make_mesh
+    from dexiraft_tpu.parallel.layout import make_train_mesh
     from dexiraft_tpu.resilience import (
         LoaderKindMismatch,
         PreemptionHandler,
@@ -293,16 +293,12 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         print(f"[cache] persistent XLA compile cache: "
               f"{enable_persistent_cache(args.compile_cache_dir)}")
 
-    # the batch shards over the data axis, so the mesh takes the largest
-    # device count that divides it (a 10-batch on 8 chips uses 2 — pick
-    # batch sizes that are multiples of the slice size to use every chip)
-    devices = jax.devices()
-    n_use = max(n for n in range(1, len(devices) + 1)
-                if tc.batch_size % n == 0)
-    if n_use < len(devices):
+    # mesh policy lives in the canonical layout (parallel/layout.py):
+    # 1-D data mesh over the largest device count dividing the batch
+    mesh = make_train_mesh(tc.batch_size)
+    if mesh.size < len(jax.devices()):
         print(f"[mesh] batch {tc.batch_size} not divisible by "
-              f"{len(devices)} devices; using {n_use}")
-    mesh = make_mesh(devices[:n_use])
+              f"{len(jax.devices())} devices; using {mesh.size}")
     state = create_state(jax.random.PRNGKey(tc.seed), cfg, tc)
     print(f"Parameter Count: {param_count(state.params)}")
 

@@ -1,5 +1,6 @@
-"""CI lint gate: run jaxlint over dexiraft_tpu/ + scripts/, exit nonzero
-on any unallowlisted finding.
+"""CI lint gate: run jaxlint over dexiraft_tpu/ + scripts/ + the
+repo-root entry points (__graft_entry__.py, bench.py), exit nonzero on
+any unallowlisted finding.
 
 This is the commit-time tripwire for the JAX/TPU footgun class the
 benches can only catch after the fact (silent recompiles, implicit
@@ -14,6 +15,9 @@ Usage:
   python scripts/lint_gate.py --emit-allow    # print ready-to-paste
                                               # baseline.json entries for
                                               # current findings
+  python scripts/lint_gate.py --stats         # per-rule finding/allowlist
+                                              # counts (rule-set growth
+                                              # stays observable)
   python scripts/lint_gate.py --list-rules
   python scripts/lint_gate.py path/to/file.py # lint specific files
 
@@ -58,6 +62,9 @@ def main(argv=None) -> int:
                     help="print baseline.json 'allow' entries for every "
                          "current finding (review before pasting!)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule finding/allowlist counts after "
+                         "the gate verdict")
     args = ap.parse_args(argv)
 
     jl = _load_jaxlint()
@@ -72,9 +79,11 @@ def main(argv=None) -> int:
 
     if args.files:
         findings = []
+        n_excluded = 0
         for rel in args.files:
             rel = rel.replace(osp.sep, "/")
             if baseline is not None and baseline.excludes(rel):
+                n_excluded += 1
                 continue
             findings.extend(jl.lint_file(osp.join(REPO, rel), rel))
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -83,7 +92,8 @@ def main(argv=None) -> int:
             stale = []  # partial run can't judge staleness
         else:
             kept, allowed, stale = findings, [], []
-        stats = {"files": len(args.files), "excluded": 0}
+        stats = {"files": len(args.files) - n_excluded,
+                 "excluded": n_excluded}
     else:
         kept, allowed, stale, stats = jl.lint_tree(REPO, baseline=baseline)
 
@@ -96,11 +106,43 @@ def main(argv=None) -> int:
     for e in stale:
         print(f"stale baseline entry (matches nothing — remove it): "
               f"{json.dumps(e)}")
-    ok = not kept and not stale
+    stale_ex = stats.get("stale_excludes", [])
+    for pat in stale_ex:
+        print(f"stale baseline exclude (matches no file — remove it): "
+              f"{pat!r}")
+    missing = stats.get("missing_scope", [])
+    for sub in missing:
+        print(f"missing scope entry (file vanished — the gate's reach "
+              f"must not silently shrink): {sub!r}")
+    ok = not kept and not stale and not stale_ex and not missing
     print(f"lint gate: {stats['files']} files, {len(kept)} finding(s), "
           f"{len(allowed)} allowlisted, {stats['excluded']} excluded"
           f"{'' if ok else ' — FAIL'}")
+    if args.stats:
+        _print_stats(jl, baseline, kept, allowed)
     return 0 if ok else 1
+
+
+def _print_stats(jl, baseline, kept, allowed) -> None:
+    """Per-rule observability: live findings, allowlisted findings, and
+    baseline allow entries, one row per rule that has any."""
+    from collections import Counter
+
+    n_kept = Counter(f.rule for f in kept)
+    n_allowed = Counter(f.rule for f in allowed)
+    n_entries = Counter(e.get("rule") for e in
+                        (baseline.allow if baseline else []))
+    print("rule   name                 findings  allowlisted  "
+          "baseline-entries")
+    for rule in sorted(jl.RULES):
+        row = (n_kept[rule], n_allowed[rule], n_entries[rule])
+        if not any(row):
+            continue
+        print(f"{rule}  {jl.RULES[rule]:<20} {row[0]:>8}  {row[1]:>11}  "
+              f"{row[2]:>16}")
+    print(f"total  {len(jl.RULES)} rules active        "
+          f"{sum(n_kept.values()):>8}  {sum(n_allowed.values()):>11}  "
+          f"{sum(n_entries.values()):>16}")
 
 
 if __name__ == "__main__":

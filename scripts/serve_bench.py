@@ -165,7 +165,7 @@ def _build_eval_fn(args, iters=None):
 
     mesh = None
     if args.data_parallel > 0:
-        from dexiraft_tpu.parallel.mesh import make_serve_mesh, replicate
+        from dexiraft_tpu.parallel.layout import make_serve_mesh, replicate
 
         mesh = make_serve_mesh(args.data_parallel)
         # params must live replicated on the mesh up front, or the

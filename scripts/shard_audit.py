@@ -1,0 +1,115 @@
+"""CI shard-audit gate: compile the train/eval/serve steps on a forced
+8-virtual-device host mesh, resolve every input/output leaf's sharding,
+and diff against the checked-in golden — exit nonzero on any drift.
+
+The static companion to scripts/lint_gate.py: lint proves specs are
+DRAWN from the canonical layout (parallel/layout.py, jaxlint JL010+);
+this proves what the compiled executables actually DO with them, and
+that nothing big resolves fully replicated (the ~200 MB correlation
+volume being the canary). Runs on CPU — GSPMD partitioning is
+platform-independent, so the resolved specs here are the pod's specs.
+Wired into the tier-1 verify command right after lint_gate.py
+(ROADMAP.md).
+
+Usage:
+  python scripts/shard_audit.py                  # gate: diff vs golden
+  python scripts/shard_audit.py --write-golden   # regenerate (review the
+                                                 # diff in the PR!)
+  python scripts/shard_audit.py --steps serve    # partial (faster) audit
+  python scripts/shard_audit.py --json           # dump the full report
+
+Exit codes: 0 clean, 1 drift or a flagged replicated group.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# The host platform must be forced BEFORE jax's backend initializes —
+# the environment's site hook pins JAX_PLATFORMS to the TPU tunnel, so
+# the env var alone is not enough (same dance as __graft_entry__).
+_N_DEVICES = 8
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={_N_DEVICES}")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("shard_audit")
+    ap.add_argument("--steps", default="train,eval,serve",
+                    help="comma-separated subset of train,eval,serve "
+                         "(partial runs diff only their sections)")
+    ap.add_argument("--golden", default=None,
+                    help="golden path (default: "
+                         "dexiraft_tpu/analysis/layout_golden.json)")
+    ap.add_argument("--write-golden", action="store_true",
+                    help="regenerate the golden from this run (always "
+                         "audits ALL steps)")
+    ap.add_argument("--threshold-mb", type=float, default=None,
+                    help="replicated-array size tripwire (default 64)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report JSON")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dexiraft_tpu.analysis import shardaudit
+
+    golden_path = args.golden or shardaudit.GOLDEN_PATH
+    threshold = (args.threshold_mb if args.threshold_mb is not None
+                 else shardaudit.DEFAULT_THRESHOLD_MB)
+    steps = [s for s in args.steps.split(",") if s]
+    unknown = set(steps) - set(shardaudit.STEP_AUDITS)
+    if unknown:
+        ap.error(f"unknown steps {sorted(unknown)}; "
+                 f"choose from {sorted(shardaudit.STEP_AUDITS)}")
+    if args.write_golden:
+        steps = sorted(shardaudit.STEP_AUDITS)
+
+    report = shardaudit.run_audit(steps, threshold_mb=threshold)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+
+    flagged = shardaudit.flagged_groups(report)
+    for line in flagged:
+        print(f"shard audit: FLAGGED {line}")
+
+    if args.write_golden:
+        if flagged:
+            print("shard audit: refusing to write a golden with flagged "
+                  "replicated groups — fix the layout first")
+            return 1
+        shardaudit.write_golden(report, golden_path)
+        print(f"shard audit: wrote {golden_path} "
+              f"(hash {shardaudit.golden_hash(golden_path)[:12]})")
+        return 0
+
+    try:
+        golden = shardaudit.load_golden(golden_path)
+    except FileNotFoundError:
+        print(f"shard audit: no golden at {golden_path} — bootstrap with "
+              f"--write-golden")
+        return 1
+    drift = shardaudit.diff_golden(report, golden)
+    for line in drift:
+        print(f"shard audit: DRIFT {line}")
+    ok = not drift and not flagged
+    print(f"shard audit: {len(steps)} step(s) "
+          f"({','.join(steps)}), {len(drift)} drift line(s), "
+          f"{len(flagged)} flagged group(s), golden "
+          f"{shardaudit.golden_hash(golden_path)[:12]}"
+          f"{'' if ok else ' — FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
