@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="upsampler implementation (numerically "
                         "identical; subpixel avoids input-dilated "
                         "convs on TPU)")
+    p.add_argument("--stall_timeout", type=float, default=0.0,
+                   help="hang watchdog: a training step making no "
+                        "progress for this many seconds dumps live "
+                        "stacks and exits nonzero instead of hanging "
+                        "(0 = disabled; see docs/resilience.md)")
     p.add_argument("--test_pich", action="store_true",
                    help="channel-swap ensemble test (reference testPich, "
                         "main.py:149-187): second forward on the BGR-swapped "
@@ -182,10 +187,32 @@ def train(args) -> None:
     # thousands (logs/dexined_demo_cpu.log), so no magnitude threshold
     guard = DivergenceGuard(threshold=float("inf"),
                             max_rollbacks=args.max_rollbacks)
+    # hang watchdog (resilience.watchdog): same contract as train_cli —
+    # a stalled step dumps live stacks and exits nonzero (inert at 0)
+    from dexiraft_tpu.resilience import HangWatchdog
+
+    wd = HangWatchdog(args.stall_timeout,
+                      label=f"dexined[{args.dataset}]").start()
     # only checkpoints written by THIS run are valid rollback targets —
     # --checkpoint defaults to a constant dir, and splicing a previous
     # experiment's weights into this one would be silent corruption
     last_saved = None
+    try:
+        _train_epochs(args, dataset, guard, wd, step, ckpt_io, rng,
+                      n, steps_per_epoch, params, batch_stats, opt_state,
+                      last_saved)
+    finally:
+        # stop WITH the loop, also on the error path: a still-armed
+        # watchdog firing during teardown would replace the real
+        # traceback with a bogus stall report
+        wd.stop()
+
+
+def _train_epochs(args, dataset, guard, wd, step, ckpt_io, rng, n,
+                  steps_per_epoch, params, batch_stats, opt_state,
+                  last_saved) -> None:
+    from dexiraft_tpu.train.state import TrainState
+
     for epoch in range(args.epochs):
         # periodic reseed like the reference's per-epoch reshuffle
         # (main.py:403-410)
@@ -195,6 +222,10 @@ def train(args) -> None:
             ids = order[(b * args.batch_size) % n:][:args.batch_size]
             if len(ids) < args.batch_size:
                 ids = order[:args.batch_size]
+            if epoch or b:
+                # never armed over the first step: it contains the XLA
+                # compile, which a step-sized timeout would misread
+                wd.arm(epoch * steps_per_epoch + b + 1)
             samples = [dataset.sample(int(i), np.random.default_rng(
                 (args.seed, epoch, int(i)))) for i in ids]
             images = np.stack([s["images"] for s in samples])
@@ -205,6 +236,11 @@ def train(args) -> None:
                 print(f"{time.ctime()} Epoch: {epoch} Sample {b}/"
                       f"{steps_per_epoch} Loss: "
                       f"{float(jax.device_get(loss)):.4f}")
+            # disarm AFTER the cadence sync above: step() returns at
+            # dispatch (async), so the device_get is where a wedged
+            # computation actually blocks — it must happen inside the
+            # armed region or the watchdog guards nothing
+            wd.disarm()
 
         state = TrainState(step=jnp.int32((epoch + 1) * steps_per_epoch),
                            params=params, batch_stats=batch_stats,
@@ -247,12 +283,11 @@ def test(args) -> None:
     step = ckpt_io.latest_step(args.checkpoint)
     if step is None:
         raise FileNotFoundError(f"no checkpoint under {args.checkpoint}")
-    # restore raw tree (params + batch_stats suffice for inference)
-    import orbax.checkpoint as ocp
-
-    mgr = ocp.CheckpointManager(osp.abspath(args.checkpoint))
-    restored = mgr.restore(step)
-    mgr.close()
+    # restore the raw tree (params + batch_stats suffice for inference)
+    # through the cached-manager path the trainers use: a fresh ad-hoc
+    # CheckpointManager cannot infer the saved item's handler (orbax
+    # KeyError on 'default') and would race a pending async flush
+    restored = ckpt_io.restore_raw(args.checkpoint, step)
     variables = {"params": restored["params"],
                  "batch_stats": restored.get("batch_stats", {})}
 

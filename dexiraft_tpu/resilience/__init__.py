@@ -26,13 +26,26 @@ ways a multi-day run dies or silently degrades:
     SIGTERM mid-step, truncated checkpoints) that the tests and
     scripts/chaos_smoke.py use to prove every recovery path recovers.
 
+Pod-grade additions (multi-host failure handling):
+
+  * coord.Coordinator — host-consensus primitives (any_flag / min_int /
+    agree_step): a NaN or preemption notice on ANY host becomes the
+    SAME verdict (and the same rollback/emergency-save step) on ALL
+    hosts; single-process runs degrade to the identity.
+  * watchdog.HangWatchdog — armed around each step/collective region; a
+    stall past the timeout dumps step index + live stacks and exits
+    nonzero instead of hanging a pod forever. Step-time EWMA straggler
+    warnings ride the same timer.
+
 The data-pipeline half (bounded retry-with-backoff, skip-and-count,
 decode-pool rebuild) lives in data.loader — PipelineStats is re-exported
 here for the one-stop import.
 """
 
 from dexiraft_tpu.data.loader import PipelineStats
+from dexiraft_tpu.resilience.coord import Coordinator
 from dexiraft_tpu.resilience.preemption import PreemptionHandler
+from dexiraft_tpu.resilience.watchdog import STALL_EXIT_CODE, HangWatchdog
 from dexiraft_tpu.resilience.retention import RetentionPolicy
 from dexiraft_tpu.resilience.stream import (
     LoaderKindMismatch,
@@ -43,20 +56,27 @@ from dexiraft_tpu.resilience.stream import (
 )
 from dexiraft_tpu.resilience.verify import (
     CheckpointIntegrityError,
+    clean_uncommitted,
     restore_verified,
+    uncommitted_flushes,
     verify_state,
 )
 
 __all__ = [
     "CheckpointIntegrityError",
+    "Coordinator",
+    "HangWatchdog",
     "LoaderKindMismatch",
     "PipelineStats",
     "PreemptionHandler",
     "RetentionPolicy",
+    "STALL_EXIT_CODE",
     "StreamPosition",
+    "clean_uncommitted",
     "delete_position",
     "load_position",
     "restore_verified",
     "save_position",
+    "uncommitted_flushes",
     "verify_state",
 ]

@@ -126,11 +126,18 @@ class WorkerDeathDataset:
 def parse_spec(spec: str) -> Callable[[int], None]:
     """Parse a --chaos spec into a per-step callback for the train loop.
 
-    Grammar: "sigterm@N" — after step N completes, send the process a
-    real SIGTERM (once). The signal flows through the installed
-    PreemptionHandler exactly as an external `kill -TERM` would, which
-    is what makes the emergency-save tests deterministic: the stop step
-    is pinned without racing a timer against compile time.
+    Grammar:
+      "sigterm@N" — after step N completes, send the process a real
+      SIGTERM (once). The signal flows through the installed
+      PreemptionHandler exactly as an external `kill -TERM` would, which
+      is what makes the emergency-save tests deterministic: the stop
+      step is pinned without racing a timer against compile time.
+      "kill_mid_flush@N" — after step N completes, arm the checkpoint
+      module so the NEXT async save os._exit()s while its flush is in
+      flight: a real crash mid-serialize, leaving an uncommitted
+      orbax tmp dir. The step's save never commits; the run's previous
+      committed step must remain the verified-restorable latest
+      (scripts/chaos_smoke.py kill-during-flush phase).
     """
     kind, _, arg = spec.partition("@")
     if kind == "sigterm":
@@ -143,7 +150,22 @@ def parse_spec(spec: str) -> Callable[[int], None]:
                 os.kill(os.getpid(), signal.SIGTERM)
 
         return fire
-    raise ValueError(f"unknown chaos spec {spec!r} (supported: sigterm@N)")
+    if kind == "kill_mid_flush":
+        at = int(arg)
+        armed = [False]
+
+        def arm(step: int) -> None:
+            if not armed[0] and step >= at:
+                armed[0] = True
+                # deferred import: this module ships to jax-free decode
+                # workers; the trainer process firing the spec has jax
+                from dexiraft_tpu.train import checkpoint as ckpt_io
+
+                ckpt_io.chaos_kill_next_flush()
+
+        return arm
+    raise ValueError(f"unknown chaos spec {spec!r} "
+                     f"(supported: sigterm@N, kill_mid_flush@N)")
 
 
 def truncate_checkpoint(directory: str, step: int) -> "list[str]":

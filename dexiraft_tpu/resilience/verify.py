@@ -22,10 +22,25 @@ re-save that retraining toward that step number performs — the run
 would "finish" with its newest checkpoint still the truncated one.
 When every candidate fails, nothing is deleted (forensics beat tidiness
 on a total loss) and CheckpointIntegrityError carries the skip list.
+
+Async saves add a third way a directory can be dirty: a crash MID-FLUSH
+(kill -9, chaos kill_mid_flush, node loss) abandons an uncommitted
+``<step>.orbax-checkpoint-tmp-*`` directory. The atomic-commit rename
+never happened, so the step is invisible to every restore path — the
+previous committed step is still the newest restorable one, which is
+the whole point — but the debris would accumulate and (same silent
+no-op hazard as above, on the tmp namespace) confuse a later flush of
+the same step. ``restore_verified`` always reports it, and removes it
+when the caller declares itself the directory's writer
+(``clean_debris=True`` — the recovering trainer; readers such as
+serve/eval must never delete another process's possibly-live flush).
 """
 
 from __future__ import annotations
 
+import os
+import os.path as osp
+import shutil
 from typing import Optional, Tuple
 
 import jax
@@ -43,6 +58,32 @@ _SAMPLE_EVERY = 7
 
 class CheckpointIntegrityError(RuntimeError):
     """No saved step under the directory passed verification."""
+
+
+def uncommitted_flushes(directory: str) -> "list[str]":
+    """Leftover orbax tmp dirs from flushes that never committed (the
+    process died mid-write). Sorted names, not paths."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(n for n in names if ".orbax-checkpoint-tmp" in n
+                  and osp.isdir(osp.join(directory, n)))
+
+
+def clean_uncommitted(directory: str, verbose: bool = True) -> "list[str]":
+    """Remove crashed-flush debris (see module docstring). Only call
+    when this process owns the directory's writes — the barrier
+    discipline in train.checkpoint guarantees no in-flight flush of our
+    own, and the single-writer checkpoint model means nobody else's."""
+    debris = uncommitted_flushes(directory)
+    for name in debris:
+        shutil.rmtree(osp.join(directory, name), ignore_errors=True)
+    if debris and verbose:
+        print(f"[resilience] removed {len(debris)} uncommitted flush(es) "
+              f"under {directory} (crash mid-save; the committed steps "
+              f"are unaffected): {debris}", flush=True)
+    return debris
 
 
 def verify_state(state, template, sample_every: int = _SAMPLE_EVERY) -> None:
@@ -87,14 +128,34 @@ def restore_verified(
     template: TrainState,
     step: Optional[int] = None,
     verbose: bool = True,
+    clean_debris: bool = False,
 ) -> Tuple[TrainState, int]:
     """Restore the newest step (<= `step` if given) that passes
     verification, falling back step by step. Returns (state, step).
+
+    clean_debris=True additionally sweeps uncommitted-flush tmp dirs —
+    pass it ONLY from the directory's writer (the trainer recovering
+    its own run): a reader (serve/eval booting off a live trainer's
+    dir) must never delete what may be another process's in-flight
+    flush. Readers still get the debris REPORTED, so a crashed run's
+    leftovers are visible wherever they are seen.
 
     Raises CheckpointIntegrityError when every candidate fails —
     crashing with the full skip list beats silently training from a
     fresh init under a name that has checkpoints.
     """
+    # barrier FIRST: an in-flight async flush of our own must commit
+    # before the debris sweep below — its live tmp dir is not debris
+    ckpt.wait_pending(directory)
+    if clean_debris:
+        clean_uncommitted(directory, verbose=verbose)
+    elif verbose:
+        debris = uncommitted_flushes(directory)
+        if debris:
+            print(f"[resilience] {len(debris)} uncommitted flush(es) "
+                  f"under {directory} (crash mid-save; left in place — "
+                  f"only the writing trainer cleans them): {debris}",
+                  flush=True)
     steps = sorted(ckpt.all_steps(directory), reverse=True)
     if step is not None:
         steps = [s for s in steps if s <= step]

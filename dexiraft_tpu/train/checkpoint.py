@@ -1,9 +1,32 @@
-"""Orbax checkpointing: full train state + partial (curriculum) restore.
+"""Orbax checkpointing: async full-state saves + partial (curriculum) restore.
 
 Upgrades the reference's torch.save(model.state_dict()) every 5k steps
 (train.py:189-190): here params, BatchNorm stats, optimizer state, step,
 and PRNG key all round-trip, so resume continues the OneCycle schedule
 instead of restarting it (the reference's documented gap, SURVEY.md §5).
+
+``save_checkpoint(block=False)`` is the pod-grade save path: the state is
+snapshotted to host synchronously (a device_get — the ONLY part the step
+loop waits for, and the snapshot is what makes the handoff safe against
+the donated train step invalidating the device buffers) and the flush
+(serialize + disk write + atomic commit) runs on a single background
+thread. ``wait_pending`` is the barrier, taken before anything that
+reads or mutates the directory — the next save, a rollback restore,
+retention GC, or exit — and it reports how long the caller actually
+blocked vs how long the flush took, so the overlap win is measurable
+(train_cli surfaces both in the logger).
+
+Atomicity is orbax's: a step flushes into ``<step>.orbax-checkpoint-tmp-*``
+and is renamed to ``<step>/`` only on commit, so a crash mid-flush leaves
+the previous committed step as the newest restorable one —
+``resilience.verify.restore_verified`` lands there (pinned by the
+kill-mid-flush chaos phase and tests/test_zzresilience.py).
+
+PRNG keys: new-style typed keys (``jax.random.key``) refuse numpy
+conversion, which used to crash orbax's serializer. ``_keys_to_data`` /
+``_data_to_keys`` are the dtype-preserving leaf handler: typed keys are
+saved as their uint32 key data and re-wrapped on restore with the
+template leaf's impl, so both key styles round-trip bit-exactly.
 
 ``restore_params_into`` reproduces load_state_dict(strict=False)
 (train.py:143-144): stage-to-stage and architecture-drift loads keep every
@@ -14,15 +37,54 @@ from __future__ import annotations
 
 import atexit
 import os
-from typing import Any, Optional, Tuple
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
 from dexiraft_tpu.train.state import TrainState
 
 
 _MANAGERS: "dict[str, ocp.CheckpointManager]" = {}
+
+# one in-flight flush per directory: step, submit time, and the future
+# running _flush on _EXECUTOR. The single-worker executor serializes all
+# background manager access; foreground access is safe because every
+# read/mutate path below takes the wait_pending barrier first.
+_PENDING: "dict[str, dict]" = {}
+_STATS: "dict[str, dict]" = {}
+_EXECUTOR: Optional[ThreadPoolExecutor] = None
+_LOCK = threading.Lock()
+
+# --- test/chaos seams (resilience.chaos, tests/test_zzresilience.py) -----
+# flush_hold: when set to an Event, the background flush waits on it
+# before touching orbax — tests use it to pin "a flush is in flight"
+# without racing real disk latency. chaos kill: the next async save
+# hard-exits the process once the flush has started (a real mid-flush
+# crash; os._exit skips atexit, so nothing downstream cleans up).
+flush_hold: Optional[threading.Event] = None
+_chaos_kill_next_flush = False
+
+
+def chaos_kill_next_flush() -> None:
+    """Arm the mid-flush crash: the next ``save_checkpoint`` initiates its
+    flush and then ``os._exit``s while it is in flight (chaos injector —
+    see resilience.chaos.parse_spec, spec ``kill_mid_flush@N``)."""
+    global _chaos_kill_next_flush
+    _chaos_kill_next_flush = True
+
+
+def _executor() -> ThreadPoolExecutor:
+    global _EXECUTOR
+    if _EXECUTOR is None:
+        _EXECUTOR = ThreadPoolExecutor(max_workers=1,
+                                       thread_name_prefix="ckpt-flush")
+    return _EXECUTOR
 
 
 def _manager(directory: str, refresh: bool = True) -> ocp.CheckpointManager:
@@ -48,46 +110,255 @@ def _manager(directory: str, refresh: bool = True) -> ocp.CheckpointManager:
 
 @atexit.register
 def close_managers() -> None:
-    """Close every cached manager (flushes pending async work).
+    """Flush pending async saves and close every cached manager.
 
     Registered atexit so long processes touching many directories (a
     pytest run's tmp dirs) don't leak orbax's per-manager machinery
-    through interpreter shutdown; safe to call earlier by hand.
+    through interpreter shutdown — and so an in-flight async save is
+    always committed before a clean exit (the "exit" barrier); safe to
+    call earlier by hand.
     """
+    for key in list(_PENDING):
+        wait_pending(key)
     for mgr in _MANAGERS.values():
         mgr.close()
     _MANAGERS.clear()
 
 
-def save_checkpoint(directory: str, state: TrainState, step: Optional[int] = None) -> None:
-    """Write <directory>/<step>/ with the full state (blocking)."""
-    mgr = _manager(directory, refresh=False)
-    s = int(state.step) if step is None else int(step)
-    mgr.save(s, args=ocp.args.StandardSave(state))
+# --- typed-PRNG-key leaf handler -----------------------------------------
+
+def _is_typed_key(leaf: Any) -> bool:
+    return jnp.issubdtype(getattr(leaf, "dtype", np.dtype(object)),
+                          jax.dtypes.prng_key)
+
+
+def _keys_to_data(tree: Any) -> Any:
+    """Replace typed PRNG-key leaves with their uint32 key data (the only
+    form orbax can serialize); old-style uint32 keys pass through."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_typed_key(x) else x, tree)
+
+
+def _data_to_keys(tree: Any, template: Any) -> Any:
+    """Re-wrap restored key data wherever the TEMPLATE leaf is a typed
+    key, preserving the template's impl (threefry2x32 etc.) — the
+    dtype-preserving half of the handler."""
+    return jax.tree.map(
+        lambda t, x: (jax.random.wrap_key_data(
+            jnp.asarray(x, jnp.uint32), impl=jax.random.key_impl(t))
+            if _is_typed_key(t) else x),
+        template, tree)
+
+
+# --- async save machinery -------------------------------------------------
+
+def _host_snapshot(tree: Any) -> Any:
+    """Host copy of every leaf (numpy), with a CLEAR error for state the
+    snapshot cannot capture.
+
+    device_get succeeds for anything with a local copy: host/numpy
+    values, single-process arrays, and multi-host REPLICATED or
+    host-addressably-sharded arrays (today's layout — REPLICATED_OK
+    pins params/opt_state as replicated). A leaf truly sharded ACROSS
+    hosts (the reserved fsdp axis, parallel/layout.fsdp_params) has no
+    local copy; snapshotting it needs orbax's per-addressable-shard
+    async path, not a device_get — refuse loudly now rather than let
+    the first pod-scale fsdp save die inside jax with a generic
+    'spans non-addressable devices'."""
+    def snap(x: Any) -> Any:
+        if isinstance(x, jax.Array) and not (
+                x.is_fully_addressable or x.is_fully_replicated):
+            raise NotImplementedError(
+                "save_checkpoint snapshots state to host before the "
+                "async flush, and this leaf is sharded across hosts "
+                "(no local copy). Cross-host-sharded (fsdp) state "
+                "needs the per-shard orbax async path — extend "
+                "train.checkpoint before sharding params over "
+                "parallel/layout's fsdp axis.")
+        return jax.device_get(x)
+
+    return jax.tree.map(snap, tree)
+
+def _flush(key: str, step: int, host_state: Any, t0: float) -> float:
+    """Background flush body: serialize + commit one step. Returns the
+    flush duration. Runs on the single ckpt-flush thread; the manager is
+    not touched by the foreground while this runs (barrier discipline)."""
+    hold = flush_hold
+    if hold is not None:
+        hold.wait()
+    mgr = _MANAGERS[key]
+    mgr.save(step, args=ocp.args.StandardSave(host_state))
     mgr.wait_until_finished()
+    return time.perf_counter() - t0
+
+
+def save_checkpoint(directory: str, state: TrainState,
+                    step: Optional[int] = None, *, block: bool = True) -> None:
+    """Write <directory>/<step>/ with the full state.
+
+    block=True (default) keeps the historical synchronous contract.
+    block=False returns as soon as the state is snapshotted to host: the
+    flush overlaps training and is committed at the next wait_pending
+    barrier (or atexit). One flush per directory may be in flight — a
+    second save first waits out the previous one.
+    """
+    key = os.path.abspath(directory)
+    wait_pending(directory)
+    _manager(directory, refresh=False)
+    s = int(jax.device_get(state.step)) if step is None else int(step)
+    # host snapshot NOW, on the caller's thread: (a) the donated train
+    # step may invalidate these device buffers one step later, (b) the
+    # caller's transfer_guard("allow") window must cover the only D2H
+    # this save performs — the background thread does pure host I/O
+    host_state = _host_snapshot(_keys_to_data(state))
+    t0 = time.perf_counter()
+    started = threading.Event()
+
+    def run() -> float:
+        started.set()
+        return _flush(key, s, host_state, t0)
+
+    future = _executor().submit(run)
+    with _LOCK:
+        _PENDING[key] = {"step": s, "t0": t0, "future": future,
+                         "started": started}
+    if _chaos_kill_next_flush:
+        # mid-flush crash injection: die once the flush is provably
+        # MID-SERIALIZE — the orbax tmp dir exists (uncommitted
+        # debris a real crash leaves) and the commit rename has not
+        # happened. os._exit skips atexit, so the pending flush is
+        # abandoned exactly as a SIGKILL would.
+        started.wait(timeout=30)
+        deadline = time.perf_counter() + 10
+        observed_mid_flush = False
+        while time.perf_counter() < deadline:
+            try:
+                names = os.listdir(key)
+            except OSError:
+                names = []
+            if any(n.startswith(f"{s}.") and "orbax-checkpoint-tmp" in n
+                   for n in names):
+                observed_mid_flush = True
+                break
+            if str(s) in names:  # the flush won the race and committed
+                break
+            time.sleep(0.002)
+        if not observed_mid_flush:
+            # never caught the window (commit raced us, or the flush
+            # errored before creating its tmp dir): exit DIFFERENTLY so
+            # the chaos phase fails with the true cause instead of a
+            # misleading 'mid-flush' claim
+            print(f"[chaos] kill_mid_flush of step {s}: flush window "
+                  f"never observed (already committed or failed); "
+                  f"exiting 8, not 7", flush=True)
+            os._exit(8)
+        print(f"[chaos] killing process mid-flush of step {s}", flush=True)
+        os._exit(7)
+    if block:
+        # the blocking contract is the historical one: a failed save
+        # RAISES at the call site, so the caller never advances its
+        # last-saved bookkeeping past a step that was never committed
+        wait_pending(directory, raise_on_error=True)
+
+
+def wait_pending(directory: Optional[str] = None,
+                 raise_on_error: bool = False) -> Optional[Dict[str, Any]]:
+    """Barrier: block until the directory's in-flight flush commits.
+
+    Returns None when nothing was pending, else a stats dict
+    {step, blocked_s, flush_s, error} — blocked_s is how long THIS call
+    waited (the step loop's real cost), flush_s how long the flush took
+    end to end (the overlapped work). A failed flush is reported loudly
+    and recorded; by default it is NOT raised — the caller's next
+    restore falls back to the previous committed step
+    (resilience.verify), which is the recovery path a crashed flush
+    needs anyway. raise_on_error=True re-raises it after the
+    accounting (the blocking-save contract).
+    """
+    if directory is None:
+        info = None
+        for key in list(_PENDING):
+            info = wait_pending(key, raise_on_error=raise_on_error) or info
+        return info
+    key = os.path.abspath(directory)
+    with _LOCK:
+        pending = _PENDING.pop(key, None)
+    if pending is None:
+        return None
+    t_wait = time.perf_counter()
+    error: Optional[str] = None
+    exc: Optional[BaseException] = None
+    flush_s = 0.0
+    try:
+        flush_s = pending["future"].result()
+    except Exception as e:  # orbax raises many types; the flush is lost
+        exc = e
+        error = f"{type(e).__name__}: {e}"
+        flush_s = time.perf_counter() - pending["t0"]
+        print(f"[checkpoint] async save of step {pending['step']} under "
+              f"{directory} FAILED ({error}); the previous committed step "
+              f"remains the latest", flush=True)
+    blocked_s = time.perf_counter() - t_wait
+    info = {"step": pending["step"], "blocked_s": blocked_s,
+            "flush_s": flush_s, "error": error}
+    with _LOCK:
+        stats = _STATS.setdefault(key, {"saves": 0, "failed": 0,
+                                        "total_blocked_s": 0.0,
+                                        "total_flush_s": 0.0})
+        stats["saves"] += 1
+        stats["failed"] += 1 if error else 0
+        stats["total_blocked_s"] += blocked_s
+        stats["total_flush_s"] += flush_s
+        stats["last"] = info
+    if exc is not None and raise_on_error:
+        raise exc
+    return info
+
+
+def pending_step(directory: str) -> Optional[int]:
+    """Step number of the directory's in-flight flush, or None."""
+    entry = _PENDING.get(os.path.abspath(directory))
+    return None if entry is None else entry["step"]
+
+
+def save_stats(directory: str) -> Dict[str, Any]:
+    """Cumulative async-save accounting for the directory: saves, failed,
+    total_blocked_s, total_flush_s, last {step, blocked_s, flush_s}."""
+    return dict(_STATS.get(os.path.abspath(directory), {}))
 
 
 def latest_step(directory: str) -> Optional[int]:
+    wait_pending(directory)
     return _manager(directory).latest_step()
 
 
 def all_steps(directory: str) -> "list[int]":
     """Ascending list of saved steps."""
+    wait_pending(directory)
     return sorted(int(s) for s in _manager(directory).all_steps())
 
 
 def delete_step(directory: str, step: int) -> None:
     """Remove one saved step (retention GC). Falls back to an rmtree of
     the step dir when the manager refuses (e.g. a half-written step the
-    manager no longer tracks)."""
+    manager no longer tracks) — naming what failed and why, so retention
+    GC failures surface in the run log instead of vanishing."""
+    wait_pending(directory)
     mgr = _manager(directory, refresh=False)
+    step_dir = os.path.join(directory, str(int(step)))
     try:
         mgr.delete(int(step))
-    except Exception:
+    except Exception as e:
+        print(f"[checkpoint] manager delete of step {step} under "
+              f"{directory} failed ({type(e).__name__}: {e}); removing "
+              f"{step_dir} directly", flush=True)
         import shutil
 
-        shutil.rmtree(os.path.join(directory, str(int(step))),
-                      ignore_errors=True)
+        shutil.rmtree(step_dir, ignore_errors=True)
+        if os.path.isdir(step_dir):
+            print(f"[checkpoint] rmtree fallback also left {step_dir} "
+                  f"behind — retention GC is NOT reclaiming this step",
+                  flush=True)
         if hasattr(mgr, "reload"):
             mgr.reload()
 
@@ -95,7 +366,9 @@ def delete_step(directory: str, step: int) -> None:
 def _fs_steps(directory: str) -> "list[int]":
     """Step dirs found by a plain filesystem walk — no CheckpointManager,
     so probing a path NEVER creates it (the cached managers are built
-    with create=True, which would turn every probe into a mkdir)."""
+    with create=True, which would turn every probe into a mkdir).
+    Uncommitted flushes (``<step>.orbax-checkpoint-tmp-*``) are not
+    digits, so a crash mid-flush never lists its half-written step."""
     try:
         names = os.listdir(directory)
     except OSError:
@@ -132,22 +405,51 @@ def restore_checkpoint(
     directory: str, template: TrainState, step: Optional[int] = None
 ) -> TrainState:
     """Restore a full TrainState; ``template`` supplies tree structure,
-    shapes, and shardings (create one with create_state)."""
+    shapes, and shardings (create one with create_state). Typed PRNG-key
+    leaves in the template are restored dtype-preserving (re-wrapped from
+    their saved key data with the template's impl)."""
+    wait_pending(directory)
     mgr = _manager(directory)
     if step is None:
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    data_template = _keys_to_data(template)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, data_template)
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    return _data_to_keys(restored, template)
+
+
+def restore_raw(directory: str, step: Optional[int] = None) -> Any:
+    """Template-free restore of the raw saved tree (numpy leaves).
+
+    The inference-only consumers (dexined test mode) have no TrainState
+    template; this goes through the SAME cached-manager path as every
+    other restore — a fresh ad-hoc CheckpointManager cannot infer the
+    saved item's handler (orbax KeyError: 'Item \"default\" … could not
+    be restored') and would race a cached manager's pending flush."""
+    wait_pending(directory)
+    mgr = _manager(directory)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    return mgr.restore(step, args=ocp.args.StandardRestore())
 
 
 def restore_params_into(
-    params: Any, restored_params: Any, verbose: bool = False
+    params: Any, restored_params: Any, verbose: bool = False,
+    skipped_report_dir: Optional[str] = None,
 ) -> Tuple[Any, list]:
     """strict=False load: graft every leaf whose path exists in both trees
     with matching shape; keep the fresh init elsewhere. Returns (merged,
-    list of skipped/missing path strings)."""
+    list of skipped/missing path strings).
+
+    verbose prints the first 8 skipped paths inline WITH the total; when
+    more were skipped the full list goes to a sidecar file
+    (<skipped_report_dir>/partial_restore_skipped.txt, cwd if not given)
+    so an architecture-drift load is auditable leaf by leaf instead of
+    ending in an ellipsis."""
     flat_new = {jax.tree_util.keystr(kp): v
                 for kp, v in jax.tree_util.tree_flatten_with_path(params)[0]}
     flat_old = {jax.tree_util.keystr(kp): v
@@ -163,7 +465,21 @@ def restore_params_into(
             skipped.append(key)
     skipped += [k for k in flat_old if k not in flat_new]
     if verbose and skipped:
-        print(f"[checkpoint] partial restore skipped {len(skipped)} leaves: {skipped[:8]}…")
+        inline_cap = 8
+        tail = ""
+        if len(skipped) > inline_cap:
+            report = os.path.join(skipped_report_dir or ".",
+                                  "partial_restore_skipped.txt")
+            try:
+                os.makedirs(os.path.dirname(report) or ".", exist_ok=True)
+                with open(report, "w") as f:
+                    f.write("\n".join(skipped) + "\n")
+                tail = f"; full list -> {report}"
+            except OSError as e:
+                tail = f"; (could not write full list: {e})"
+        print(f"[checkpoint] partial restore skipped {len(skipped)} leaves "
+              f"(first {min(inline_cap, len(skipped))} of {len(skipped)}): "
+              f"{skipped[:inline_cap]}{tail}")
 
     # rebuild the tree: map leaves back by path order
     paths, treedef = jax.tree_util.tree_flatten_with_path(params)

@@ -172,7 +172,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos", default=None, metavar="SPEC",
                    help="fault injection for tests/scripts/chaos_smoke "
                         "(resilience.chaos.parse_spec), e.g. "
-                        "'sigterm@30': real SIGTERM after step 30")
+                        "'sigterm@30': real SIGTERM after step 30, "
+                        "'kill_mid_flush@30': hard-kill during the next "
+                        "async checkpoint flush")
+    # pod-grade failure handling (docs/resilience.md "Multi-host"):
+    # checkpoint flushes are async (the loop only pays the host
+    # snapshot; wait_pending barriers sit before the next save /
+    # validation / rollback / GC / exit), failure verdicts are
+    # host-collective, and a hang is bounded by a watchdog
+    p.add_argument("--stall_timeout", type=float, default=0.0,
+                   help="hang watchdog: a step/collective region making "
+                        "no progress for this many seconds dumps the "
+                        "step index + live stack traces and exits "
+                        "nonzero instead of hanging the pod "
+                        "(0 = disabled; sanctioned slow windows — "
+                        "checkpoint, validation, restore — get 10x "
+                        "this bound)")
+    p.add_argument("--straggler_factor", type=float, default=10.0,
+                   help="warn when a step runs this many times the "
+                        "step-time EWMA (same watchdog timer; needs "
+                        "--stall_timeout > 0)")
+    p.add_argument("--coord_every", type=int, default=10,
+                   help="multi-host: poll the coordinated preemption "
+                        "flag every N steps (one tiny allgather; "
+                        "divergence verdicts coordinate on "
+                        "--guard_every; single-process runs never "
+                        "issue a collective)")
     # runtime guard mode (analysis/guards.py, docs/static_analysis.md):
     # the dynamic half of the jaxlint story. Off, drift still surfaces
     # as a one-line warning on the guard cadence.
@@ -271,6 +296,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     from dexiraft_tpu.data.prefetch import prefetch_to_device
     from dexiraft_tpu.parallel.layout import make_train_mesh
     from dexiraft_tpu.resilience import (
+        Coordinator,
+        HangWatchdog,
         LoaderKindMismatch,
         PreemptionHandler,
         RetentionPolicy,
@@ -351,10 +378,68 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     # checkpointed as a sidecar with every save, so --resume continues
     # the exact sample sequence instead of replaying from epoch 0
     stream_pos = StreamPosition()
-    if args.resume and ckpt.latest_step(ckpt_dir) is not None:
+    # host-consensus primitives (resilience.coord): identity on a single
+    # process, one tiny allgather per decision on a multi-host mesh —
+    # every failure verdict below (divergence, preemption, resume step)
+    # is the SAME on every host, so no host ever rolls back or exits
+    # alone into a hung collective
+    coord = Coordinator()
+    # hang watchdog (resilience.watchdog): created and started BEFORE
+    # the first consensus exchange below, so a peer dying during the
+    # startup restore is bounded and stack-dumped like any other hang.
+    # Inert at timeout 0.
+    wd = HangWatchdog(args.stall_timeout,
+                      straggler_factor=args.straggler_factor,
+                      label=f"train[{tc.name}]").start()
+    # one throwaway consensus exchange FIRST: coordination-service
+    # breakage surfaces here, loudly, before any real verdict depends
+    # on it (no-op single-process)
+    wd.arm(0, "coord-warmup", steady=False)
+    try:
+        coord.warmup()
+    finally:
+        # disarm on the error path too: a raise here skips the loop's
+        # finally, and an armed region left over an exception teardown
+        # would fire a bogus stall over the real traceback
+        wd.disarm()
+    # the resume decision must be COLLECTIVE: agree_step is a lockstep
+    # exchange, so a host skipping it while peers enter would strand
+    # them mid-round. All-hosts-have gates the restore; a MIXED mesh
+    # (some hosts have checkpoints, some lost theirs) refuses: starting
+    # fresh over a stale directory would silently collide with the old
+    # run's step numbers (orbax no-ops a save onto an existing step
+    # dir), splicing old state into the new run at the first rollback.
+    # short-circuit on args.resume: latest_step constructs a cached
+    # manager with create=True, and a non-resume run must not turn the
+    # probe into a mkdir (checkpoint._fs_steps documents the hazard)
+    have_ckpt = args.resume and ckpt.latest_step(ckpt_dir) is not None
+    all_have = args.resume and not coord.any_flag(not have_ckpt)
+    have_any = args.resume and coord.any_flag(have_ckpt)
+    if have_any and not all_have:
+        sys.exit(f"[resume] checkpoints under {ckpt_dir} exist on "
+                 f"{'this host' if have_ckpt else 'a peer host'} but "
+                 f"not on every host — resuming would desync the mesh, "
+                 f"and training fresh over a stale directory would "
+                 f"splice the old run's checkpoints into this one; "
+                 f"restore or clear the checkpoint directories so all "
+                 f"hosts agree, or drop --resume and use a fresh "
+                 f"--name/--output")
+    if all_have:
         # verified restore: a truncated/poisoned newest step falls back
-        # to the previous one with a message instead of crashing here
-        state, last_saved = restore_verified(ckpt_dir, state)
+        # to the previous one with a message instead of crashing here.
+        # Multi-host: agree_step pins every host to the SAME restored
+        # step (min over hosts of what each disk verifiably holds), so
+        # a restart never straddles two checkpoints. clean_debris: the
+        # trainer owns this directory's writes — crashed-flush tmp
+        # dirs are swept here.
+        wd.arm(0, "resume-restore", steady=False)
+        try:
+            state, last_saved = coord.agree_step(
+                lambda bound: restore_verified(ckpt_dir, state, step=bound,
+                                               clean_debris=True),
+                None)
+        finally:
+            wd.disarm()
         try:
             pos = load_position(ckpt_dir, last_saved, seed=tc.seed,
                                 loader_kind=loader_kind,
@@ -370,8 +455,9 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     elif args.restore_ckpt:
         ckpt.require_checkpoints(args.restore_ckpt)
         prev = ckpt.restore_checkpoint(args.restore_ckpt, state)
-        merged, skipped = ckpt.restore_params_into(state.params, prev.params,
-                                                   verbose=True)
+        merged, skipped = ckpt.restore_params_into(
+            state.params, prev.params, verbose=True,
+            skipped_report_dir=osp.join(args.log_dir, tc.name))
         state = state.replace(params=merged, batch_stats=prev.batch_stats)
         print(f"Partial restore from {args.restore_ckpt} "
               f"({len(skipped)} leaves fresh)")
@@ -433,19 +519,55 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     metrics = None
     preempted = False
 
-    def save_with_position(step: int) -> None:
+    def note_flush(info) -> None:
+        """Surface one committed (or failed) async flush in the logger:
+        blocked_s is what the step loop actually paid, flush_s the work
+        that overlapped training — the async-save win is their ratio."""
+        if not info:
+            return
+        print(f"[ckpt] step {info['step']}: flush {info['flush_s']*1e3:.0f}"
+              f" ms, train blocked {info['blocked_s']*1e3:.0f} ms"
+              + (f" (FLUSH FAILED: {info['error']})" if info["error"]
+                 else ""))
+        logger.write_dict({"ckpt/save_blocked_s": info["blocked_s"],
+                           "ckpt/flush_s": info["flush_s"]},
+                          step=info["step"])
+
+    def save_with_position(step: int, block: bool = False) -> None:
         """Checkpoint + stream-position sidecar + retention GC, as one
-        operation — every save leaves a resumable, bounded directory."""
+        operation — every save leaves a resumable, bounded directory.
+
+        The checkpoint flush is ASYNC: the previous save's flush is
+        barriered out first (wait_pending — its blocked/flush times go
+        to the logger), retention GC runs against the committed
+        directory, and only then is the new flush handed off; training
+        overlaps it until the next barrier (save / validation window /
+        rollback / exit). The guard verdict was taken by the caller
+        BEFORE this runs, so a poisoned state is never handed off.
+        block=True (emergency/final save) commits before returning."""
         nonlocal last_saved
         # checkpoint I/O is a sanctioned host sync — exempt from the
         # strict transfer guard
         with jax.transfer_guard("allow"):
-            ckpt.save_checkpoint(ckpt_dir, state, step=step)
+            note_flush(ckpt.wait_pending(ckpt_dir))
+            # GC BEFORE the new handoff: delete_step barriers on any
+            # in-flight flush, so GC after would serialize save+GC and
+            # surrender the overlap
+            retention.apply(ckpt_dir, protect=(last_saved,))
+            ckpt.save_checkpoint(ckpt_dir, state, step=step, block=False)
             save_position(ckpt_dir, step, stream_pos, seed=tc.seed,
                           loader_kind=loader_kind,
                           fingerprint=pack_fingerprint)
+            if block:
+                info = ckpt.wait_pending(ckpt_dir)
+                note_flush(info)
+                if info and info["error"]:
+                    # an emergency/final save that did not commit must
+                    # not be reported (or bookkept) as a checkpoint
+                    raise RuntimeError(
+                        f"checkpoint flush of step {step} failed: "
+                        f"{info['error']}")
         last_saved = step
-        retention.apply(ckpt_dir, protect=(last_saved,))
 
     # fault injection for the chaos tests/smoke: a real signal/fault
     # fired at a pinned step, flowing through the real recovery paths
@@ -466,6 +588,10 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     preempt = PreemptionHandler()
     try:
         with preempt, mesh:
+            # NOT armed over the first iteration: it contains the XLA
+            # compile, whose minutes would either trip a steady-state
+            # stall_timeout or deaden the straggler EWMA. The watchdog
+            # arms once the steady-state contract does (watch warmup).
             for batch in batches:
                 # range-based (not equality) so resumed runs landing inside
                 # the window still profile, and stop only pairs with a start
@@ -474,14 +600,18 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     prof_active = True
                 state, metrics = step_fn(state, batch)
                 total_steps += 1
-                if watch is None:
+                first_iteration = watch is None
+                if first_iteration:
                     # the first step of this process just compiled —
-                    # arm the steady-state contract from here
+                    # arm the steady-state contract from here (the
+                    # watchdog included: its timeout is sized for
+                    # steps, not compiles)
                     watch = jaxguards.RecompileWatch(f"train[{tc.name}]")
                     watch.mark_warm()
                     if args.strict:
                         guard_stack.enter_context(
                             jax.transfer_guard("disallow"))
+                    wd.arm(total_steps + 1, "step+data")
                 # note: advanced on CONSUMPTION, never rewound by a
                 # rollback — the stream continues past a divergent
                 # window instead of replaying it. The loader publishes
@@ -511,19 +641,38 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     # the state the checkpoint below would save
                     state_ok = bool(jax.device_get(
                         metrics.get("state_finite", True)))
-                    if guard.poisoned(loss_v, state_ok):
+                    # a poisoned verdict on ANY host rolls back ALL
+                    # hosts — one host restoring alone while its peers
+                    # keep stepping is a hung collective, not a
+                    # recovery (identity single-process)
+                    poisoned_here = guard.poisoned(loss_v, state_ok)
+                    if coord.any_flag(poisoned_here):
+                        # the agreed target: the newest step EVERY host
+                        # has saved (-1 encodes "nothing saved yet", and
+                        # min() makes any such host abort the mesh)
+                        agreed = coord.min_int(
+                            last_saved if last_saved is not None else -1)
+                        target = None if agreed < 0 else agreed
                         guard.consume_rollback(
-                            loss_v, state_ok, f"step {total_steps}",
-                            last_saved, ckpt_dir=ckpt_dir)
+                            loss_v, state_ok, f"step {total_steps}"
+                            + ("" if poisoned_here
+                               else " (verdict from a peer host)"),
+                            target, ckpt_dir=ckpt_dir)
                         # verified restore: should the rollback target
                         # itself turn out damaged, fall back further
-                        # rather than crash mid-recovery. Restore is
-                        # sanctioned host I/O (strict-guard exempt), and
-                        # it may recompile nothing — but the guard must
-                        # not turn recovery into a second failure.
+                        # rather than crash mid-recovery — and re-agree
+                        # across hosts until everyone restored the SAME
+                        # step. Restore is sanctioned host I/O (strict-
+                        # guard exempt); the guard must not turn
+                        # recovery into a second failure.
+                        wd.disarm(feed_ewma=False)
+                        wd.arm(total_steps, "rollback-restore", steady=False)
                         with jax.transfer_guard("allow"):
-                            state, last_saved = restore_verified(
-                                ckpt_dir, state, step=last_saved)
+                            state, last_saved = coord.agree_step(
+                                lambda b: restore_verified(
+                                    ckpt_dir, state, step=b,
+                                    clean_debris=True),
+                                target)
                         # the restored state has no fresh metrics; leaving
                         # the poisoned step's here would make the END-OF-RUN
                         # guard below veto the final save of a GOOD state
@@ -533,7 +682,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                         # the nominal target must not tell the operator
                         # to inspect a checkpoint that was never used
                         print(f"[guard] loss {loss_v:.4g} "
-                              f"(state_finite={state_ok}) at step "
+                              f"(state_finite={state_ok}, "
+                              f"poisoned_here={poisoned_here}) at step "
                               f"{total_steps}; restored {ckpt_dir} step "
                               f"{last_saved} (rollback {guard.rollbacks}/"
                               f"{args.max_rollbacks})")
@@ -543,6 +693,8 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                         logger.rewind(logger.total_steps
                                       - (total_steps - last_saved))
                         total_steps = last_saved
+                        wd.disarm(feed_ewma=False)
+                        wd.arm(total_steps + 1, "step+data")
                         continue  # never checkpoint on a rollback step
 
                 # recompile sentinel, on the same cadence as the guard:
@@ -554,13 +706,26 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     else:
                         watch.warn_if_drifted()
 
-                if preempt.triggered:
+                # preemption is a COLLECTIVE verdict: one host's SIGTERM
+                # must stop every host at the same step (a lone host
+                # saving-and-exiting strands its peers in the next
+                # collective). Single-process: the local flag, checked
+                # every step, exactly as before; multi-host: one tiny
+                # allgather every --coord_every steps.
+                if coord.size == 1:
+                    stop_now = preempt.triggered
+                else:
+                    stop_now = (total_steps % args.coord_every == 0
+                                and coord.any_flag(preempt.triggered))
+                if stop_now:
                     # graceful preemption: ONE emergency save at the
                     # step boundary (guard-checked — preemption is not a
                     # license to persist a poisoned state), then leave
                     # the loop; the position sidecar makes the later
                     # --resume continue the exact sample sequence
                     preempted = True
+                    wd.disarm(feed_ewma=False)
+                    wd.arm(total_steps, "emergency-save", steady=False)
                     if args.on_preempt == "save":
                         poisoned = False
                         if not args.no_guard and metrics is not None:
@@ -568,12 +733,17 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                             state_ok = bool(jax.device_get(
                                 metrics.get("state_finite", True)))
                             poisoned = guard.poisoned(loss_v, state_ok)
-                        if poisoned:
+                        # the save is all-hosts-or-none (orbax's save is
+                        # itself collective): one host's poison vetoes
+                        # the emergency save everywhere
+                        if coord.any_flag(poisoned):
                             print(f"[preempt] state at step {total_steps} "
                                   f"is poisoned; NOT saving — latest good "
                                   f"checkpoint remains step {last_saved}")
                         else:
-                            save_with_position(total_steps)
+                            # block: the process exits right after — the
+                            # flush must commit before it does
+                            save_with_position(total_steps, block=True)
                             print(f"[preempt] emergency checkpoint: "
                                   f"{ckpt_dir} step {total_steps} (data "
                                   f"stream epoch {stream_pos.epoch}, batch "
@@ -585,12 +755,34 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                               f"{last_saved})")
                     break
 
-                if total_steps % tc.val_freq == 0:
+                in_val_window = total_steps % tc.val_freq == 0
+                if in_val_window:
+                    # the step part of this iteration is done: feed its
+                    # duration to the straggler EWMA (not on the first
+                    # iteration — its armed window is partial) and
+                    # re-arm over the sanctioned (slow)
+                    # checkpoint+validation stretch
+                    wd.disarm(feed_ewma=not first_iteration)
+                    wd.arm(total_steps, "checkpoint+validation",
+                           steady=False)
                     save_with_position(total_steps)
                     # validation is a sanctioned window: its eval steps
                     # compile once per set (absorbed by mark_warm below)
                     # and its dataset readers are host-side by design
                     with jax.transfer_guard("allow"):
+                        if tc.validation:
+                            # barrier before the validation window —
+                            # the resilience contract's barrier set
+                            # (save/validation/rollback/GC/exit), kept
+                            # deliberately even though it trades away
+                            # flush-over-validation overlap: validation
+                            # notes retention scores for the step being
+                            # flushed, and a window where --keep_best
+                            # ranks a checkpoint whose flush later
+                            # FAILS would protect a step that does not
+                            # exist. Runs without validation sets keep
+                            # the full overlap.
+                            note_flush(ckpt.wait_pending(ckpt_dir))
                         for vname in tc.validation:
                             results = validate(vname)
                             logger.write_dict(results, step=total_steps)
@@ -607,6 +799,17 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     watch.mark_warm()
                 if total_steps >= tc.num_steps:
                     break
+                # close this iteration's armed window (a validation
+                # window stays out of the step-time EWMA, and the
+                # first iteration's partial mid-body arm never seeds
+                # it) and open the next — the re-arm also covers the
+                # prefetch fetch between iterations. A first iteration
+                # that landed on a val window still re-arms here, so
+                # the non-steady validation region never leaks over
+                # the next iteration.
+                if not first_iteration or in_val_window:
+                    wd.disarm(feed_ewma=not in_val_window)
+                    wd.arm(total_steps + 1, "step+data")
     finally:
         # stop the host pipeline — on the happy path AND when the loop
         # dies (interrupt, OOM, failed restore): the Loader's feeder
@@ -618,6 +821,9 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         # path — a leaked 'disallow' would poison later jax use in this
         # process); the final save below is host I/O, not steady state
         guard_stack.close()
+        # the monitor must not outlive the loop: the exit path below is
+        # host I/O whose duration has nothing to do with step progress
+        wd.stop()
     if prof_active:  # window extended past the last step: finalize
         jax.profiler.stop_trace()
         print(f"[profile] trace (truncated at end of run) -> {prof_dir}")
@@ -637,7 +843,24 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                   f"skipping the final save — latest good checkpoint "
                   f"remains step {last_saved}")
     if final_ok:
-        save_with_position(total_steps)
+        # block: this is the exit barrier — the process must not return
+        # control with a flush still in flight
+        save_with_position(total_steps, block=True)
+    else:
+        # even a vetoed final save barriers out any in-flight flush of
+        # an earlier GOOD state before the process exits
+        with jax.transfer_guard("allow"):
+            note_flush(ckpt.wait_pending(ckpt_dir))
+    cstats = ckpt.save_stats(ckpt_dir)
+    if cstats.get("saves"):
+        print(f"[ckpt] {cstats['saves']} async save(s): total flush "
+              f"{cstats['total_flush_s']:.2f}s overlapped, total train "
+              f"blocked {cstats['total_blocked_s']:.2f}s"
+              + (f", {cstats['failed']} FAILED" if cstats.get("failed")
+                 else ""))
+    if wd.enabled and wd.straggler_warnings:
+        print(f"[watchdog] {wd.straggler_warnings} straggler warning(s) "
+              f"this run (EWMA step {wd.ewma_s:.2f}s)")
     logger.close()
     print(f"[prefetch] {batches.summary()}")
     if loader.stats.faults:
@@ -662,6 +885,11 @@ def main(argv=None) -> None:
 
     initialize()  # no-op single-process; multi-host via env vars
     args = build_parser().parse_args(argv)
+    if args.coord_every < 1:
+        sys.exit("train: --coord_every must be >= 1 (it is a step "
+                 "modulus; there is no 'never poll' mode — preemption "
+                 "broadcast is what keeps a multi-host mesh exiting "
+                 "together)")
     cfg, tc = resolve_configs(args)
     train(cfg, tc, args)
 

@@ -1,12 +1,13 @@
-"""Chaos smoke: a short single-process CPU run proving the recovery paths
-(~2 min on a laptop-class CPU, dominated by the one XLA compile).
+"""Chaos smoke: a short CPU run proving the recovery paths recover
+(~4 min on a laptop-class CPU, dominated by the XLA compiles).
 
-Injects the four fault families the resilience layer claims to survive —
-corrupt samples, decode-worker death, SIGTERM mid-run, and a truncated
-checkpoint — against the REAL loader and the REAL train CLI on a tiny
-synthetic chairs tree, and exits nonzero if any path fails to recover.
-Intended for CI and for a quick sanity check after touching the
-train/data path:
+Injects the fault families the resilience layer claims to survive —
+corrupt samples, decode-worker death, SIGTERM mid-run, a truncated
+checkpoint, a hard kill DURING an async checkpoint flush, and a dead
+virtual host on a 2-process mesh — against the REAL loader, the REAL
+train CLI, and the real multiprocess runtime, and exits nonzero if any
+path fails to recover. Intended for CI and for a quick sanity check
+after touching the train/data/resilience path:
 
     python scripts/chaos_smoke.py 2>&1 | tee logs/chaos_smoke.log
 
@@ -20,12 +21,28 @@ Phases:
                      final params BIT-EXACT vs an uninterrupted run
   4 truncated-ckpt   newest checkpoint file truncated: verified restore
                      falls back to the previous step
+  5 kill-mid-flush   train_cli killed while an async checkpoint flush
+                     is in flight (--chaos kill_mid_flush@N, a real
+                     os._exit mid-serialize): the uncommitted step is
+                     invisible, restore_verified lands on the prior
+                     committed step, --resume completes the run
+  6 multihost-kill   2-process virtual mesh, one host os._exit()s
+                     mid-run: the survivor exits NONZERO (watchdog /
+                     collective error) instead of hanging, and a
+                     --resume pair agrees on one step and finishes
+                     BIT-EXACT vs an uninterrupted reference pair
+
+The last stdout line is a JSON record with per-phase recovery
+wall-times (`[chaos] record {...}` — RECORD_KEYS pins the schema), so
+recovery-latency regressions are visible run-over-run in the logs.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import os.path as osp
+import subprocess
 import sys
 import tempfile
 import time
@@ -35,6 +52,10 @@ sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
+
+# JSON-tail schema: per-phase {ok, wall_s} plus totals
+RECORD_KEYS = ("phases", "failures", "total_s")
+PHASE_KEYS = ("ok", "wall_s")
 
 
 def _build_chairs_tree(tmp: str, n: int = 8) -> None:
@@ -159,9 +180,116 @@ def phase_truncated_checkpoint(tmp: str) -> None:
     print(f"    step {steps[-1]} truncated -> restored step {got} instead")
 
 
+def _train_subprocess(tmp: str, cli_args, expect_rc: int,
+                      timeout: float = 600.0) -> str:
+    """Run train_cli in a SUBPROCESS (the injected fault is a real
+    os._exit — in-process it would take the smoke down) and assert the
+    exit code. Returns combined output."""
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    env = {**os.environ, "DEXIRAFT_DATA_DIR": tmp,
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dexiraft_tpu", "train", *cli_args],
+        cwd=tmp, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=timeout)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == expect_rc, \
+        f"expected rc {expect_rc}, got {proc.returncode}:\n{out[-3000:]}"
+    return out
+
+
+def phase_kill_mid_flush(tmp: str) -> None:
+    import jax
+
+    from dexiraft_tpu.config import TrainConfig, raft_v1
+    from dexiraft_tpu.resilience import restore_verified, \
+        uncommitted_flushes
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train.state import create_state
+
+    args = _train_args(tmp, "flushkill", 6,
+                       ["--val_freq", "2", "--validation"])
+    # saves at 2/4/6; the chaos spec arms at step 3, so step 4's async
+    # flush is the one killed in flight (rc 7 = the injector's exit)
+    out = _train_subprocess(
+        tmp, args + ["--chaos", "kill_mid_flush@3"], expect_rc=7)
+    assert "killing process mid-flush of step 4" in out, out[-2000:]
+    ckpt_dir = f"{tmp}/ckpts/flushkill"
+    debris = uncommitted_flushes(ckpt_dir)
+    assert debris, "kill was not mid-serialize: no uncommitted tmp dir"
+    template = create_state(jax.random.PRNGKey(0), raft_v1(small=True),
+                            TrainConfig())
+    # clean_debris: this is the WRITER recovering its own directory
+    state, got = restore_verified(ckpt_dir, template, clean_debris=True)
+    assert got == 2, f"expected fallback to committed step 2, got {got}"
+    assert uncommitted_flushes(ckpt_dir) == [], "debris not cleaned"
+    # and the run completes from the prior committed step
+    out = _train_subprocess(tmp, args + ["--resume"], expect_rc=0)
+    assert ckpt.latest_step(ckpt_dir) == 6
+    assert "flush" in out and "train blocked" in out  # async stats logged
+    print(f"    killed mid-flush of step 4 (debris: {len(debris)} tmp "
+          f"dir(s)) -> restore_verified landed on step {got}; --resume "
+          f"completed to step 6")
+
+
+def phase_multihost_kill(tmp: str) -> None:
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    child = osp.join(repo, "tests", "multiproc_resilience_child.py")
+    # the SAME pair orchestration the tier-1 multihost tests use (kill
+    # + reap on timeout, placeholder logs), so smoke and suite cannot
+    # drift
+    from tests._mp_common import spawn_child_pair
+
+    def spawn_pair(tag, ckpt_dir, extra):
+        outs = [f"{tmp}/{tag}{pid}.json" for pid in range(2)]
+        rcs, logs, _ = spawn_child_pair(
+            child, outs, ckpt_dir,
+            extra=["--num_steps", "8", "--save_every", "2", *extra],
+            timeout=240.0)
+        return rcs, logs
+
+    rcs, logs = spawn_pair("ref", f"{tmp}/mh_ref",
+                           ["--stall_timeout", "60"])
+    assert rcs == [0, 0], f"reference pair failed:\n{logs[0][-2000:]}"
+    t_kill = time.perf_counter()
+    rcs, logs = spawn_pair("cut", f"{tmp}/mh_cut",
+                           ["--die_step", "5", "--die_host", "1",
+                            "--stall_timeout", "20"])
+    abort_s = time.perf_counter() - t_kill
+    assert rcs[1] == 3, logs[1][-1500:]
+    survivor_rc = rcs[0]
+    # the survivor must abort ITSELF (watchdog 98 / hard-exit 97) —
+    # a -9 means spawn_child_pair's timeout killed a hung survivor,
+    # which is exactly the outcome this phase exists to disprove
+    assert survivor_rc not in (0, None, -9), \
+        f"survivor rc {survivor_rc} — expected a coordinated nonzero " \
+        f"exit:\n{logs[0][-1500:]}"
+    assert "<killed: timed out>" not in logs[0], \
+        "survivor hung past the spawn timeout — the watchdog did not " \
+        "bound the dead-peer collective"
+    assert abort_s < 150, \
+        f"survivor took {abort_s:.0f}s to abort — the watchdog did " \
+        f"not bound the hang"
+    rcs, logs = spawn_pair("res", f"{tmp}/mh_cut",
+                           ["--resume", "--stall_timeout", "60"])
+    assert rcs == [0, 0], f"resume pair failed:\n{logs[0][-2000:]}"
+    ref = [json.load(open(f"{tmp}/ref{i}.json")) for i in range(2)]
+    res = [json.load(open(f"{tmp}/res{i}.json")) for i in range(2)]
+    resumed = [r["events"][0]["resumed"] for r in res]
+    assert resumed[0] == resumed[1], resumed
+    assert res[0]["final_w"] == ref[0]["final_w"] == res[1]["final_w"], \
+        "resumed params diverged from the uninterrupted reference"
+    print(f"    host 1 killed at step 5 -> survivor aborted nonzero "
+          f"(rc {survivor_rc}) in {abort_s:.0f}s; resume pair agreed on "
+          f"step {resumed[0]} and finished BIT-EXACT vs the "
+          f"uninterrupted pair")
+
+
 def main() -> int:
     t_start = time.perf_counter()
     failures = []
+    record: dict = {}
     with tempfile.TemporaryDirectory() as tmp:
         _build_chairs_tree(tmp)
         os.environ["DEXIRAFT_DATA_DIR"] = tmp
@@ -172,6 +300,8 @@ def main() -> int:
             ("worker-death", phase_worker_death),
             ("sigterm-resume", lambda: phase_sigterm_resume(tmp)),
             ("truncated-ckpt", lambda: phase_truncated_checkpoint(tmp)),
+            ("kill-mid-flush", lambda: phase_kill_mid_flush(tmp)),
+            ("multihost-kill", lambda: phase_multihost_kill(tmp)),
         ]
         try:
             for name, fn in phases:
@@ -179,21 +309,31 @@ def main() -> int:
                 print(f"[chaos] {name} ...", flush=True)
                 try:
                     fn()
+                    ok = True
                     print(f"[chaos] {name} PASS "
                           f"({time.perf_counter() - t0:.1f}s)", flush=True)
                 except Exception:
                     traceback.print_exc()
+                    ok = False
                     print(f"[chaos] {name} FAIL", flush=True)
                     failures.append(name)
+                # per-phase recovery wall-time: the run-over-run signal
+                # for recovery-latency regressions
+                record[name] = {"ok": ok,
+                                "wall_s": round(time.perf_counter() - t0,
+                                                1)}
         finally:
             os.chdir(cwd)
     total = time.perf_counter() - t_start
     if failures:
         print(f"[chaos] FAILED: {failures} ({total:.1f}s)")
-        return 1
-    print(f"[chaos] all {len(phases)} recovery paths recovered "
-          f"({total:.1f}s)")
-    return 0
+    else:
+        print(f"[chaos] all {len(phases)} recovery paths recovered "
+              f"({total:.1f}s)")
+    print("[chaos] record " + json.dumps(
+        {"phases": record, "failures": failures,
+         "total_s": round(total, 1)}, sort_keys=True), flush=True)
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -75,3 +75,80 @@ def make_configs():
     tc = TrainConfig(name="mp-test", num_steps=16, batch_size=GLOBAL_BATCH,
                      image_size=IMAGE_SIZE, iters=2, lr=1e-4, wdecay=1e-5)
     return cfg, tc
+
+
+def spawn_child_pair(child_path, outs, ckpt_dir, extra=(),
+                     timeout: float = 300.0):
+    """Two spawned children, one rendezvous port; returns
+    ([rc0, rc1], [log0, log1], wall_s).
+
+    Shared by tests/test_zzmultihost_resilience.py and
+    scripts/chaos_smoke.py (multihost phase) so the pair orchestration
+    cannot drift between the suite and the smoke. Never raises on a
+    hung child: it is killed and reaped, its log slot is the
+    '<killed: timed out>' placeholder, and its returncode reports the
+    kill — callers assert on exit codes with the surviving logs
+    attached, which is exactly the diagnosis a hang needs.
+    XLA_FLAGS is stripped so children control their own virtual device
+    count."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen(
+        [sys.executable, str(child_path), "--port", str(port),
+         "--process_id", str(pid), "--out", str(out),
+         "--ckpt_dir", str(ckpt_dir), *[str(a) for a in extra]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid, out in enumerate(outs)]
+    logs = []
+    try:
+        for p in procs:
+            try:
+                logs.append(p.communicate(timeout=timeout)[0]
+                            .decode(errors="replace"))
+            except subprocess.TimeoutExpired:
+                logs.append("<killed: timed out>")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    return [p.returncode for p in procs], logs, time.perf_counter() - t0
+
+
+def patch_orbax_kv_barriers() -> None:
+    """Reroute orbax's process-sync onto its distributed-client barrier.
+
+    orbax 0.7.0's ``sync_global_processes`` defaults to an XLA allgather
+    (``multihost_utils.sync_global_devices``) that this container's CPU
+    backend cannot run ("Multiprocess computations aren't implemented on
+    the CPU backend") — but orbax already ships the non-XLA alternative,
+    ``get_barrier_sync_fn`` over the jax.distributed coordination
+    service (the path newer orbax versions default to). Semantically the
+    same barrier, carried by gRPC instead of a compiled collective.
+
+    Called by the multiprocess resilience children (and the chaos-smoke
+    multihost phase): on real TPU pods the XLA barrier exists and this
+    shim is unnecessary; on the 2-process virtual CPU mesh it is the
+    difference between exercising the real multiprocess checkpoint path
+    and not testing it at all.
+    """
+    from orbax.checkpoint import multihost as omh_pkg
+    from orbax.checkpoint.multihost import utils as omh
+
+    def kv_sync(name, *, timeout=None, processes=None,
+                barrier_sync_fn=None):
+        fn = barrier_sync_fn or omh.get_barrier_sync_fn(
+            processes=processes)
+        fn(key=name, timeout_ms=int((timeout or 300) * 1000))
+
+    omh.sync_global_processes = kv_sync
+    omh_pkg.sync_global_processes = kv_sync

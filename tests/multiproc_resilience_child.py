@@ -1,0 +1,217 @@
+"""One virtual host of the multi-host resilience test (kill-one-host /
+one-host-poison / coordinated resume).
+
+Spawned (not imported) by tests/test_zzmultihost_resilience.py and by
+scripts/chaos_smoke.py, twice per scenario: each child owns 2 virtual
+CPU devices, joins its peer over jax.distributed, and runs a tiny but
+REAL resilient train loop — the same primitives train_cli wires:
+async checkpoint saves with wait_pending barriers (train.checkpoint),
+host-consensus verdicts (resilience.coord), verified agreed restore
+(resilience.verify), and the hang watchdog (resilience.watchdog).
+
+The model is deliberately tiny (one dense matrix, SGD): the scenarios
+pin COORDINATION semantics — same rollback step on every host, a dead
+peer bounded by the watchdog instead of a hung collective, bit-exact
+resume from the agreed step — not model numerics, and the suite's
+870 s budget cannot afford a RAFT compile per child here.
+
+Each host runs the step REPLICATED (full global batch, locally): this
+container's CPU backend implements no cross-process XLA at all
+("Multiprocess computations aren't implemented"), so the sharded-step
+half of the multi-host story lives in tests/test_multiprocess.py (and
+on real hardware), while THESE scenarios pin everything that is
+host-side — consensus, async checkpointing through orbax's real
+multiprocess path (via _mp_common.patch_orbax_kv_barriers), verified
+agreed restore, and the watchdog. Replicated compute is exactly what
+those layers see on a pod anyway: identical state, identical verdicts.
+
+Fault injection:
+  --poison_step N --poison_host K   host K's LOCAL verdict says
+      poisoned after step N (a host-local fault by construction: the
+      loss itself is replicated, so only a local verdict can prove the
+      consensus path) — every host must roll back to the same step.
+  --die_step N --die_host K         host K os._exit(3)s after step N;
+      the survivor must exit nonzero via watchdog/collective error,
+      never hang.
+
+Any exception exits via os._exit(97): atexit would otherwise run the
+checkpoint barrier against a dead peer and hang the "no hang" test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import os.path as osp
+import sys
+import traceback
+
+sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+GLOBAL_BATCH = 8
+FEATURES = 16
+COLLECTIVE_ERROR_EXIT = 97
+
+
+def global_batch(step: int):
+    """Deterministic pure function of the GLOBAL step index — the
+    bit-exact-resume property needs nothing else."""
+    r = np.random.default_rng(900 + step)
+    x = r.normal(size=(GLOBAL_BATCH, FEATURES)).astype(np.float32)
+    y = r.normal(size=(GLOBAL_BATCH, FEATURES)).astype(np.float32)
+    return x, y
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--num_processes", type=int, default=2)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--num_steps", type=int, default=8)
+    ap.add_argument("--save_every", type=int, default=2)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--poison_step", type=int, default=None)
+    ap.add_argument("--poison_host", type=int, default=0)
+    ap.add_argument("--die_step", type=int, default=None)
+    ap.add_argument("--die_host", type=int, default=1)
+    ap.add_argument("--stall_timeout", type=float, default=25.0)
+    args = ap.parse_args()
+
+    from dexiraft_tpu.parallel.distributed import initialize
+
+    initialize(coordinator_address=f"127.0.0.1:{args.port}",
+               num_processes=args.num_processes,
+               process_id=args.process_id)
+    pid = jax.process_index()
+
+    import optax
+
+    from tests._mp_common import patch_orbax_kv_barriers
+    from dexiraft_tpu.resilience import Coordinator, HangWatchdog, \
+        restore_verified
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train.state import TrainState
+
+    # the CPU backend has no XLA process sync; orbax's real multiprocess
+    # barriers ride the coordination service instead (see _mp_common)
+    patch_orbax_kv_barriers()
+
+    tx = optax.sgd(0.05)
+    w0 = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (FEATURES, FEATURES)),
+        np.float32)
+    params = {"w": jnp.asarray(w0)}
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       batch_stats={}, opt_state=tx.init(params),
+                       rng=jax.random.PRNGKey(0))
+
+    @jax.jit
+    def step_fn(state, x, y):
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state), loss
+
+    coord = Coordinator()
+    wd = HangWatchdog(args.stall_timeout, label=f"mpchild{pid}").start()
+    coord.warmup()
+
+    start = 0
+    last_saved = None
+    events = []
+    if args.resume:
+        # agreed resume: every host lands on the SAME verified step
+        state, start = coord.agree_step(
+            lambda b: restore_verified(args.ckpt_dir, state, step=b,
+                                       verbose=False,
+                                       clean_debris=True), None)
+        last_saved = start
+        events.append({"resumed": start})
+
+    losses = []
+    for step in range(start + 1, args.num_steps + 1):
+        wd.arm(step)
+        x, y = global_batch(step)
+        state, loss = step_fn(state, jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(jax.device_get(loss)))
+
+        if args.die_step is not None and step == args.die_step \
+                and pid == args.die_host:
+            print(f"[chaos] host {pid} dying at step {step}",
+                  flush=True)
+            os._exit(3)
+
+        # host-LOCAL poison verdict -> collective decision
+        poisoned_here = (args.poison_step is not None
+                         and step == args.poison_step
+                         and pid == args.poison_host)
+        if coord.any_flag(poisoned_here):
+            agreed = coord.min_int(
+                last_saved if last_saved is not None else -1)
+            target = None if agreed < 0 else agreed
+            state, restored = coord.agree_step(
+                lambda b: restore_verified(args.ckpt_dir, state,
+                                           step=b, verbose=False,
+                                           clean_debris=True),
+                target)
+            last_saved = restored
+            events.append({"rollback_at": step, "restored": restored,
+                           "poisoned_here": bool(poisoned_here)})
+        elif step % args.save_every == 0:
+            # async save: the flush overlaps the following steps;
+            # the next save (or exit) takes the barrier
+            ckpt.save_checkpoint(args.ckpt_dir, state, step=step,
+                                 block=False)
+            last_saved = step
+        wd.disarm()
+
+    info = ckpt.wait_pending(args.ckpt_dir)  # exit barrier
+    wd.stop()
+    norm = float(np.sqrt(sum(
+        float(np.sum(np.asarray(x) ** 2))
+        for x in jax.tree.leaves(jax.device_get(state.params)))))
+    result = {
+        "process_id": pid,
+        "losses": losses,
+        "events": events,
+        "param_norm": norm,
+        "final_w": np.asarray(jax.device_get(state.params["w"])).tolist(),
+        "saved_steps": ckpt.all_steps(args.ckpt_dir),
+        "last_flush": None if info is None else
+            {k: info[k] for k in ("step", "blocked_s", "flush_s")},
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+    print("child done", json.dumps(result)[:160], flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException:
+        # never let atexit (checkpoint barrier against a possibly dead
+        # peer) turn an error into a hang — report and leave hard
+        traceback.print_exc()
+        sys.stderr.flush()
+        os._exit(COLLECTIVE_ERROR_EXIT)

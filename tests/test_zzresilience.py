@@ -440,3 +440,350 @@ class TestMissingCheckpointErrors:
             ["--model", str(tmp_path / "nope"), "--dataset", "chairs"])
         with pytest.raises(SystemExit, match="no checkpoints under"):
             load_variables(args)
+
+
+# --- pod-grade additions: async saves, consensus, watchdog ----------------
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_returns_before_flush_commits(self, tmp_path):
+        import threading
+
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        d = str(tmp_path / "ck")
+        state = _toy_state()
+        hold = threading.Event()
+        ckpt.flush_hold = hold
+        try:
+            ckpt.save_checkpoint(d, state, step=1, block=False)
+            # the flush is provably in flight (held), yet save returned
+            assert ckpt.pending_step(d) == 1
+            threading.Timer(0.05, hold.set).start()
+            info = ckpt.wait_pending(d)
+        finally:
+            ckpt.flush_hold = None
+        assert info["step"] == 1 and info["error"] is None
+        assert info["flush_s"] >= info["blocked_s"] > 0
+        stats = ckpt.save_stats(d)
+        assert stats["saves"] == 1 and stats["failed"] == 0
+        assert ckpt.all_steps(d) == [1]
+
+    def test_poisoned_verdict_during_inflight_flush(self, tmp_path):
+        """The guard+save interleaving contract: a poisoned loss arriving
+        while a previous (guard-checked, good) flush is still in flight
+        neither commits the poisoned state nor orphans the in-flight
+        save — the rollback barrier commits it, then restores it."""
+        import threading
+
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.resilience import restore_verified
+        from dexiraft_tpu.train import checkpoint as ckpt
+        from dexiraft_tpu.train.guard import DivergenceGuard
+
+        d = str(tmp_path / "ck")
+        good1 = _toy_state()
+        good2 = good1.replace(
+            step=jnp.int32(2),
+            params={"w": good1.params["w"] + 1, "b": good1.params["b"]})
+        ckpt.save_checkpoint(d, good1, step=1)  # committed baseline
+
+        hold = threading.Event()
+        ckpt.flush_hold = hold
+        try:
+            # step 2's guard verdict was taken BEFORE this handoff
+            ckpt.save_checkpoint(d, good2, step=2, block=False)
+            last_saved = 2
+            # ... two steps later the loss explodes: train_cli's rollback
+            # discipline — guard verdict, then barrier, then restore
+            guard = DivergenceGuard(threshold=1e4)
+            assert guard.poisoned(float("nan"), True)
+            assert ckpt.pending_step(d) == 2  # flush genuinely in flight
+            threading.Timer(0.05, hold.set).start()
+            state, restored = restore_verified(d, good1, step=last_saved,
+                                               verbose=False)
+        finally:
+            ckpt.flush_hold = None
+        # the in-flight save was NOT orphaned: the barrier inside the
+        # restore path committed it, and the rollback landed on it
+        assert restored == 2
+        np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                      np.asarray(good2.params["w"]))
+        # and the poisoned state never reached disk at all
+        assert ckpt.all_steps(d) == [1, 2]
+
+    def test_crash_mid_flush_debris_cleaned_and_prior_step_restores(
+            self, tmp_path, capsys):
+        from dexiraft_tpu.resilience import (
+            restore_verified,
+            uncommitted_flushes,
+        )
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        d = str(tmp_path / "ck")
+        state = _toy_state()
+        ckpt.save_checkpoint(d, state, step=3)
+        # what a kill mid-flush leaves behind: an uncommitted orbax tmp
+        # dir for the NEXT step (the rename-commit never happened)
+        debris = tmp_path / "ck" / "4.orbax-checkpoint-tmp-123456"
+        debris.mkdir()
+        (debris / "partial").write_bytes(b"x" * 64)
+        assert uncommitted_flushes(d) == [debris.name]
+        # a READER (serve/eval) reports the debris but must never
+        # delete it — it may be another process's live in-flight flush
+        restored, got = restore_verified(d, state)
+        assert got == 3
+        assert uncommitted_flushes(d) == [debris.name]
+        assert "left in place" in capsys.readouterr().out
+        # the WRITER recovering its own directory sweeps it
+        restored, got = restore_verified(d, state, clean_debris=True)
+        assert got == 3  # the prior committed step is the latest
+        assert uncommitted_flushes(d) == []  # debris reported + removed
+        assert "uncommitted flush" in capsys.readouterr().out
+        assert ckpt.all_steps(d) == [3]
+
+    def test_failed_flush_reports_and_never_raises(self, tmp_path,
+                                                   monkeypatch, capsys):
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        d = str(tmp_path / "ck")
+
+        def boom(key, step, host_state, t0):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(ckpt, "_flush", boom)
+        ckpt.save_checkpoint(d, _toy_state(), step=5, block=False)
+        info = ckpt.wait_pending(d)
+        assert info["error"] and "disk on fire" in info["error"]
+        assert "FAILED" in capsys.readouterr().out
+        assert ckpt.save_stats(d)["failed"] == 1
+        # a BLOCKING save keeps the historical contract: it raises at
+        # the call site, so callers never bookkeep an uncommitted step
+        with pytest.raises(OSError, match="disk on fire"):
+            ckpt.save_checkpoint(d, _toy_state(), step=6, block=True)
+        # the directory stays usable: nothing committed, reads work
+        monkeypatch.undo()
+        assert ckpt.latest_step(d) is None
+
+    def test_typed_prng_key_roundtrips_dtype_preserving(self, tmp_path):
+        import jax
+
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        d = str(tmp_path / "ck")
+        state = _toy_state().replace(rng=jax.random.key(3))
+        ckpt.save_checkpoint(d, state, step=1)
+        template = _toy_state().replace(rng=jax.random.key(0))
+        restored = ckpt.restore_checkpoint(d, template)
+        assert restored.rng.dtype == state.rng.dtype  # key<fry>, not u32
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(restored.rng)),
+            np.asarray(jax.random.key_data(state.rng)))
+        # and the old-style uint32 key path is untouched
+        ckpt.save_checkpoint(d, _toy_state(), step=2)
+        old = ckpt.restore_checkpoint(d, _toy_state(), step=2)
+        assert old.rng.dtype == np.uint32
+
+    def test_chaos_kill_mid_flush_spec_arms_once(self):
+        from dexiraft_tpu.resilience import chaos as chaos_lib
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        fire = chaos_lib.parse_spec("kill_mid_flush@3")
+        try:
+            fire(2)
+            assert not ckpt._chaos_kill_next_flush
+            fire(3)
+            assert ckpt._chaos_kill_next_flush
+        finally:
+            ckpt._chaos_kill_next_flush = False  # never kill this pytest
+
+
+class TestDeleteStepLogging:
+    def test_manager_refusal_names_step_and_dir(self, tmp_path, capsys):
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        d = str(tmp_path / "ck")
+        ckpt.save_checkpoint(d, _toy_state(), step=1)
+        ckpt.delete_step(d, 999)  # the manager has no step 999
+        out = capsys.readouterr().out
+        assert "999" in out and str(d) in out and "failed" in out
+
+
+class TestPartialRestoreSkipReport:
+    def test_full_skip_list_lands_in_sidecar(self, tmp_path, capsys):
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        params = {f"fresh_{i}": np.zeros((2,)) for i in range(12)}
+        restored = {f"old_{i}": np.zeros((2,)) for i in range(3)}
+        merged, skipped = ckpt.restore_params_into(
+            params, restored, verbose=True,
+            skipped_report_dir=str(tmp_path))
+        assert len(skipped) == 15
+        out = capsys.readouterr().out
+        assert "15 leaves" in out
+        report = tmp_path / "partial_restore_skipped.txt"
+        assert str(report) in out
+        lines = report.read_text().strip().splitlines()
+        assert len(lines) == 15
+        assert set(lines) == set(skipped)
+
+    def test_small_skip_list_stays_inline(self, tmp_path, capsys):
+        from dexiraft_tpu.train import checkpoint as ckpt
+
+        params = {"a": np.zeros((2,)), "b": np.zeros((3,))}
+        merged, skipped = ckpt.restore_params_into(
+            params, {"a": np.zeros((5,))}, verbose=True,
+            skipped_report_dir=str(tmp_path))
+        # 'a' (shape mismatch) and 'b' (missing) both count, inline only
+        assert "2 leaves" in capsys.readouterr().out
+        assert not (tmp_path / "partial_restore_skipped.txt").exists()
+
+
+class TestHangWatchdog:
+    def _wd(self, tmp_path, timeout=10.0, **kw):
+        import io
+
+        from dexiraft_tpu.resilience import HangWatchdog
+
+        clk = [0.0]
+        exits = []
+        out = open(tmp_path / "wd.log", "w+")
+        wd = HangWatchdog(timeout, clock=lambda: clk[0],
+                          exit_fn=exits.append, stream=out, **kw)
+        return wd, clk, exits, out
+
+    def test_stall_dumps_stacks_and_exits_nonzero(self, tmp_path):
+        from dexiraft_tpu.resilience import STALL_EXIT_CODE
+
+        wd, clk, exits, out = self._wd(tmp_path, timeout=10.0)
+        wd.arm(42, "step+data")
+        clk[0] = 9.0
+        assert wd.check_once() is None
+        clk[0] = 10.5
+        assert wd.check_once() == "stall"
+        assert exits == [STALL_EXIT_CODE] and wd.fired
+        out.seek(0)
+        dump = out.read()
+        out.close()
+        assert "step 42" in dump and "step+data" in dump
+        assert "Thread" in dump  # faulthandler live-stack dump
+
+    def test_straggler_warns_once_on_ewma(self, tmp_path):
+        wd, clk, exits, out = self._wd(tmp_path, timeout=100.0,
+                                       straggler_factor=10.0)
+        # four 1s steps -> EWMA 1s
+        for step in range(4):
+            wd.arm(step)
+            clk[0] += 1.0
+            wd.disarm()
+        assert wd.ewma_s == pytest.approx(1.0)
+        wd.arm(5)
+        clk[0] += 11.0  # > 10x EWMA, < timeout
+        assert wd.check_once() == "straggler"
+        assert wd.check_once() is None  # once per armed region
+        assert wd.straggler_warnings == 1 and not exits
+        out.seek(0)
+        assert "straggler" in out.read()
+        out.close()
+
+    def test_sanctioned_windows_stay_out_of_ewma_and_straggler(
+            self, tmp_path):
+        wd, clk, exits, out = self._wd(tmp_path, timeout=100.0)
+        # seed the EWMA with fast steady steps
+        for step in range(3):
+            wd.arm(step)
+            clk[0] += 0.5
+            wd.disarm()
+        # a sanctioned slow region: no EWMA feed, no straggler warning,
+        # and the stall bound is scaled by slow_region_factor (10x) —
+        # a legitimate 2-minute validation sweep must not be killed by
+        # a step-sized timeout
+        wd.arm(9, "checkpoint+validation", steady=False)
+        clk[0] += 500.0  # 1000x the EWMA, 5x timeout, < 10x timeout
+        assert wd.check_once() is None
+        assert wd.straggler_warnings == 0
+        assert wd.disarm() is not None
+        assert wd.ewma_s == pytest.approx(0.5)
+        wd.arm(10, "checkpoint+validation", steady=False)
+        clk[0] += 1001.0  # past 10x the timeout: still fires
+        assert wd.check_once() == "stall"
+        assert exits  # the stall bound is scaled, never waived
+        out.close()
+
+    def test_timeout_zero_is_inert(self, tmp_path):
+        wd, clk, exits, out = self._wd(tmp_path, timeout=0.0)
+        assert not wd.enabled
+        wd.arm(1)
+        clk[0] = 1e9
+        assert wd.check_once() is None and not exits
+        assert wd.start()._thread is None  # no monitor thread either
+        out.close()
+
+
+class TestCoordinator:
+    def test_single_process_is_identity(self):
+        from dexiraft_tpu.resilience import Coordinator
+
+        calls = []
+        coord = Coordinator(size=1, index=0,
+                            allgather_fn=lambda v: calls.append(v))
+        assert coord.any_flag(True) is True
+        assert coord.any_flag(False) is False
+        assert coord.min_int(7) == 7
+        state, step = coord.agree_step(
+            lambda b: (("state", b), 4), None)
+        assert (state, step) == (("state", None), 4)
+        coord.warmup()
+        assert calls == []  # never a collective
+
+    def test_any_flag_and_min_over_hosts(self):
+        from dexiraft_tpu.resilience import Coordinator
+
+        peers = {"flags": [False, True], "steps": [40, 20]}
+
+        def fake_allgather(v):
+            import numpy as _np
+
+            if v.dtype == bool:
+                return _np.asarray([[f] for f in peers["flags"]])
+            return _np.asarray([[s] for s in peers["steps"]])
+
+        coord = Coordinator(size=2, index=0, allgather_fn=fake_allgather)
+        assert coord.any_flag(False) is True  # the PEER's verdict wins
+        assert coord.min_int(40) == 20
+
+    def test_agree_step_converges_to_global_min(self):
+        from dexiraft_tpu.resilience import Coordinator
+
+        # this host restored 4, the peer only has 2: round 1 agrees on
+        # 2, round 2 this host re-restores at 2 and everyone matches
+        script = iter([
+            np.asarray([[4], [2]]),          # min_int round 1 -> 2
+            np.asarray([[True], [False]]),   # any_flag: mismatch
+            np.asarray([[2], [2]]),          # min_int round 2 -> 2
+            np.asarray([[False], [False]]),  # any_flag: agreed
+        ])
+        coord = Coordinator(size=2, index=0,
+                            allgather_fn=lambda v: next(script))
+        restores = []
+
+        def restore_fn(bound):
+            restores.append(bound)
+            step = 4 if bound is None else min(4, bound)
+            return f"state@{step}", step
+
+        state, step = coord.agree_step(restore_fn, None)
+        assert (state, step) == ("state@2", 2)
+        assert restores == [None, 2]  # re-restored at the agreed min
+
+    def test_agree_step_gives_up_after_max_rounds(self):
+        from dexiraft_tpu.resilience import Coordinator
+
+        coord = Coordinator(
+            size=2, index=1,
+            allgather_fn=lambda v: (np.asarray([[True], [True]])
+                                    if v.dtype == bool
+                                    else np.asarray([[0], [1]])))
+        with pytest.raises(RuntimeError, match="no checkpoint step"):
+            coord.agree_step(lambda b: ("s", 1), None, max_rounds=2)
