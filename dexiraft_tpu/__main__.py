@@ -1,10 +1,11 @@
-"""Package dispatcher: python -m dexiraft_tpu {train,eval,serve,dexined} ..."""
+"""Package dispatcher: python -m dexiraft_tpu
+{train,eval,serve,router,dexined,viz} ..."""
 
 import sys
 
 
 def main() -> None:
-    cmds = ("train", "eval", "serve", "dexined", "viz")
+    cmds = ("train", "eval", "serve", "router", "dexined", "viz")
     if len(sys.argv) < 2 or sys.argv[1] not in cmds:
         print(f"usage: python -m dexiraft_tpu {{{','.join(cmds)}}} [args...]",
               file=sys.stderr)
@@ -16,6 +17,8 @@ def main() -> None:
         from dexiraft_tpu.eval_cli import main as run
     elif cmd == "serve":
         from dexiraft_tpu.serve_cli import main as run
+    elif cmd == "router":
+        from dexiraft_tpu.router_cli import main as run
     elif cmd == "viz":
         from dexiraft_tpu.viz_cli import main as run
     else:

@@ -1,15 +1,20 @@
-"""Serving subsystem: throughput-mode inference engine (ISSUE 3) plus
-the persistent flow service around it (ISSUE 6) — SLO-aware request
-scheduling, session warm-start affinity, and the stdlib HTTP tier.
+"""Serving subsystem: throughput-mode inference engine (ISSUE 3), the
+persistent flow service around it (ISSUE 6) — SLO-aware request
+scheduling, session warm-start affinity, the stdlib HTTP tier — and
+the fleet router over N replicas (ISSUE 11): health-checked circuit
+breakers, consistent-hash session affinity, zero-drop failover.
 
 Import layering: buckets/engine/scheduler/sessions import no jax at
 module level (unit-testable with a numpy stub eval_fn); server pulls
-them together; serve_cli owns the jax-heavy restore/step construction.
+them together; router imports no jax at all (pure control plane);
+serve_cli owns the jax-heavy restore/step construction.
 """
 
 from dexiraft_tpu.serve.buckets import BucketRegistry, bucket_shape
 from dexiraft_tpu.serve.engine import (InferenceEngine, Result, ServeConfig,
                                        add_engine_args)
+from dexiraft_tpu.serve.router import (HashRing, NoHealthyReplica,
+                                       ReplicaPool, Router, RouterConfig)
 from dexiraft_tpu.serve.scheduler import (QueueFull, Scheduler,
                                           SchedulerClosed, SchedulerStats)
 from dexiraft_tpu.serve.server import FlowService
@@ -17,6 +22,11 @@ from dexiraft_tpu.serve.sessions import SessionStore
 
 __all__ = [
     "FlowService",
+    "HashRing",
+    "NoHealthyReplica",
+    "ReplicaPool",
+    "Router",
+    "RouterConfig",
     "BucketRegistry",
     "bucket_shape",
     "InferenceEngine",

@@ -163,6 +163,7 @@ class Scheduler:
         self._queues: Dict[Tuple[int, int], "collections.deque[_Request]"] \
             = {}
         self._pending = 0
+        self._dispatched = 0   # popped for a batch, result not yet set
         self._service_s: Dict[Tuple[int, int], float] = {}
         self._draining = False
         self._closed = False
@@ -223,6 +224,15 @@ class Scheduler:
         with self._cv:
             return self._pending
 
+    def inflight(self) -> int:
+        """Admitted-but-unanswered requests: queued PLUS mid-dispatch.
+        The /healthz readiness payload reports this so a router's drain
+        can wait for genuinely-zero outstanding work — queue_depth alone
+        goes to 0 the moment the last batch is TAKEN, while its
+        requests are still computing in the engine."""
+        with self._cv:
+            return self._pending + self._dispatched
+
     # ---- dispatch decision (dispatcher thread / tests) ------------------
 
     def _hold_s(self, bucket: Tuple[int, int]) -> float:
@@ -255,6 +265,7 @@ class Scheduler:
         q = self._queues[bucket]
         group = [q.popleft() for _ in range(min(len(q), bs))]
         self._pending -= len(group)
+        self._dispatched += len(group)
         return group, len(group) == bs
 
     def poll_once(self) -> bool:
@@ -273,6 +284,15 @@ class Scheduler:
 
     def _run(self, bucket: Tuple[int, int], group: List[_Request],
              full: bool) -> None:
+        try:
+            self._run_inner(bucket, group, full)
+        finally:
+            with self._cv:
+                self._dispatched -= len(group)
+                self._cv.notify_all()   # inflight()==0 pollers re-check
+
+    def _run_inner(self, bucket: Tuple[int, int], group: List[_Request],
+                   full: bool) -> None:
         st = self.stats
         if full:
             st.dispatch_full += 1
@@ -399,11 +419,13 @@ class Scheduler:
         per-bucket service estimates (the SLO policy's working memory)."""
         with self._cv:
             depth = self._pending
+            inflight = self._pending + self._dispatched
             ests = {f"{h}x{w}": round(s * 1e3, 2)
                     for (h, w), s in sorted(self._service_s.items())}
         return {
             **self.stats.record(),
             "queue_depth": depth,
+            "inflight": inflight,
             "slo_ms": round(self.slo_s * 1e3, 2),
             "max_queue": self.max_queue,
             "service_est_ms": ests,

@@ -17,8 +17,16 @@ Endpoints:
                     ``X-Bucket`` headers describe what served it.
                     400 malformed, 503 queue-full/draining, 504 SLO-
                     timeout, 500 engine error.
-  GET  /healthz     JSON liveness; 200 while serving, 503 once draining
-                    (load balancers stop routing before the exit).
+  GET  /healthz     JSON READINESS; 200 while serving, 503 once
+                    draining (load balancers stop routing before the
+                    exit). The payload always carries {draining,
+                    inflight, sessions}: a router can tell "dying"
+                    (drain in progress, inflight counting down) from
+                    "busy" and can poll inflight to 0 for a zero-drop
+                    drain.
+  GET  /livez       JSON LIVENESS; 200 as long as the process answers
+                    — stays 200 through a drain. Restart on /livez,
+                    route on /healthz.
   GET  /stats       JSON {service, engine, scheduler, sessions} —
                     ServeStats/SchedulerStats/SessionStore records.
                     ``?reset=1`` zeroes the counters after the scrape
@@ -53,6 +61,7 @@ import numpy as np
 
 from dexiraft_tpu.serve.buckets import bucket_shape
 from dexiraft_tpu.serve.engine import InferenceEngine
+from dexiraft_tpu.serve.httputil import QuietDisconnectsMixin
 from dexiraft_tpu.serve.scheduler import (QueueFull, Scheduler,
                                           SchedulerClosed)
 from dexiraft_tpu.serve.sessions import SessionStore
@@ -97,7 +106,7 @@ def decode_response(body: bytes) -> np.ndarray:
 # ---- HTTP plumbing ------------------------------------------------------
 
 
-class _FlowHTTPServer(ThreadingHTTPServer):
+class _FlowHTTPServer(QuietDisconnectsMixin, ThreadingHTTPServer):
     """ThreadingHTTPServer that (a) carries the FlowService reference,
     (b) JOINS handler threads on close — the drain path's guarantee that
     every admitted response is flushed before exit — and (c) optionally
@@ -162,15 +171,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         svc = self.server.service
         url = urlparse(self.path)
-        if url.path == "/healthz":
-            if svc.draining:
-                self._send_json(503, {"status": "draining"})
-            else:
-                self._send_json(200, {
-                    "status": "ok",
-                    "uptime_s": round(svc.uptime_s(), 3),
-                    "queue_depth": svc.scheduler.queue_depth(),
-                })
+        if url.path == "/livez":
+            # liveness: 200 as long as the process answers — a DRAINING
+            # replica is alive (finishing admitted work), only a dead
+            # one fails this. Routers restart on /livez, route on
+            # /healthz.
+            self._send_json(200, {"status": "alive"})
+        elif url.path == "/healthz":
+            # readiness: 503 once draining (load balancers stop routing
+            # before the exit), but the payload always reports the full
+            # {draining, inflight, sessions} picture so a router can
+            # tell "dying" (drain + inflight counting down) from "busy"
+            # (ready with a deep queue) instead of a bare status flip.
+            payload = svc.health_record()
+            self._send_json(503 if payload["draining"] else 200, payload)
         elif url.path == "/stats":
             reset = parse_qs(url.query).get("reset", ["0"])[0] == "1"
             payload = (svc.snapshot_and_reset() if reset
@@ -333,6 +347,22 @@ class FlowService:
 
     def uptime_s(self) -> float:
         return self.clock() - self._t0
+
+    def health_record(self) -> dict:
+        """The /healthz readiness payload: liveness is implied by
+        answering at all; readiness is `not draining`; `inflight`
+        (admitted-but-unanswered, queued AND mid-batch) is what a
+        router's zero-drop drain polls down to 0; `sessions` says how
+        much warm state dies with this replica."""
+        return {
+            "status": "draining" if self.draining else "ok",
+            "draining": self.draining,
+            "inflight": self.scheduler.inflight(),
+            "sessions": len(self.sessions) if self.sessions is not None
+            else 0,
+            "uptime_s": round(self.uptime_s(), 3),
+            "queue_depth": self.scheduler.queue_depth(),
+        }
 
     def stats_record(self) -> dict:
         return {

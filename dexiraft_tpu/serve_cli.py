@@ -33,8 +33,13 @@ from dexiraft_tpu.serve.engine import ServeConfig, add_engine_args
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dexiraft-serve")
-    p.add_argument("--model", required=True, help="orbax checkpoint dir "
+    p.add_argument("--model", default=None, help="orbax checkpoint dir "
                    "(restored via the verified-restore fallback path)")
+    p.add_argument("--synthetic_init", action="store_true",
+                   help="serve RANDOM-init weights instead of a "
+                        "checkpoint — load/capacity benches and fleet "
+                        "chaos tests exercise the full serving stack "
+                        "without shipping a model around")
     p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
@@ -155,7 +160,9 @@ def _run_pool(args, argv) -> None:
 def _load(args):
     """Verified restore (PR 4): the newest checkpoint step that passes
     integrity checks serves; truncated/poisoned steps are skipped (and
-    deleted) loudly instead of crashing the worker at boot."""
+    deleted) loudly instead of crashing the worker at boot.
+    --synthetic_init skips the restore entirely (random weights): the
+    fleet bench/chaos replicas measure the serving stack, not EPE."""
     import jax
 
     from dexiraft_tpu.config import TrainConfig
@@ -163,10 +170,6 @@ def _load(args):
     from dexiraft_tpu.train import checkpoint as ckpt
     from dexiraft_tpu.train.state import create_state
 
-    try:
-        ckpt.require_checkpoints(args.model)
-    except FileNotFoundError as e:
-        raise SystemExit(f"serve: {e}")
     if args.fused_update and args.corr_impl != "pallas":
         raise SystemExit("serve: --fused_update requires --corr_impl pallas")
     cfg = VARIANTS[args.variant](small=args.small,
@@ -176,6 +179,16 @@ def _load(args):
                                  fused_update=args.fused_update,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
+    if args.synthetic_init:
+        state = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
+        print("[serve] synthetic init: serving RANDOM weights "
+              "(bench/chaos mode — flow quality is meaningless)",
+              flush=True)
+        return cfg, state.variables
+    try:
+        ckpt.require_checkpoints(args.model)
+    except FileNotFoundError as e:
+        raise SystemExit(f"serve: {e}")
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     state, step = restore_verified(args.model, template)
     # the server never saves: release orbax's per-manager machinery now
@@ -280,10 +293,25 @@ def _serve_one(args) -> None:
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     args = build_parser().parse_args(argv)
+    if bool(args.model) == bool(args.synthetic_init):
+        raise SystemExit("serve: exactly one of --model or "
+                         "--synthetic_init is required")
     if args.workers < 1:
         raise SystemExit(f"serve: --workers must be >= 1, got "
                          f"{args.workers}")
     if args.workers > 1:
+        if args.session_ttl_s > 0:
+            # the PR 6 affinity gap, made loud: SO_REUSEPORT pools give
+            # sessions no home — the kernel balances accepts blindly,
+            # so a stream's warm carry lands on the wrong worker half
+            # the time. The router (python -m dexiraft_tpu router) is
+            # the sanctioned multi-replica path for session traffic.
+            print("[serve] WARNING: --workers > 1 has NO session "
+                  "affinity (SO_REUSEPORT accept-balancing is blind); "
+                  "sessions are forced OFF in the pool. For warm-start "
+                  "at scale, front single-worker replicas with "
+                  "`python -m dexiraft_tpu router` (docs/serving.md "
+                  "\"Fleet\").", flush=True)
         _run_pool(args, argv)
     else:
         _serve_one(args)

@@ -3,10 +3,11 @@
 
 Injects the fault families the resilience layer claims to survive —
 corrupt samples, decode-worker death, SIGTERM mid-run, a truncated
-checkpoint, a hard kill DURING an async checkpoint flush, and a dead
-virtual host on a 2-process mesh — against the REAL loader, the REAL
-train CLI, and the real multiprocess runtime, and exits nonzero if any
-path fails to recover. Intended for CI and for a quick sanity check
+checkpoint, a hard kill DURING an async checkpoint flush, a dead
+virtual host on a 2-process mesh, and a serve replica SIGKILLed behind
+the fleet router — against the REAL loader, the REAL train CLI, the
+real multiprocess runtime, and real serve processes, and exits nonzero
+if any path fails to recover. Intended for CI and for a quick sanity check
 after touching the train/data/resilience path:
 
     python scripts/chaos_smoke.py 2>&1 | tee logs/chaos_smoke.log
@@ -31,6 +32,13 @@ Phases:
                      collective error) instead of hanging, and a
                      --resume pair agrees on one step and finishes
                      BIT-EXACT vs an uninterrupted reference pair
+  7 router-failover  2 serve replicas behind the fleet router
+                     (serve/router.py), one SIGKILLed under closed-loop
+                     session load: ZERO accepted requests dropped (the
+                     router's failover retry + the clients' connection
+                     retry absorb the death), the breaker opens inside
+                     the recovery bound, and the dead replica's
+                     sessions remap (sticky misses, then warm again)
 
 The last stdout line is a JSON record with per-phase recovery
 wall-times (`[chaos] record {...}` — RECORD_KEYS pins the schema), so
@@ -286,6 +294,110 @@ def phase_multihost_kill(tmp: str) -> None:
           f"uninterrupted pair")
 
 
+def phase_router_failover(tmp: str) -> None:
+    """Kill 1 of 2 replicas behind the fleet router under closed-loop
+    session load. Recovery contract: zero accepted requests dropped
+    (router failover + client connection-retry absorb the death), the
+    victim's breaker opens inside the bound, sessions remap."""
+    import threading
+    from urllib.parse import urlparse
+
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    sys.path.insert(0, osp.join(repo, "scripts"))
+    try:
+        from serve_bench import _client_thread, _free_ports
+    finally:
+        sys.path.pop(0)
+    from dexiraft_tpu.router_cli import spawn_replica, wait_ready
+    from dexiraft_tpu.serve.router import Router, RouterConfig
+    from dexiraft_tpu.serve.server import encode_request
+
+    ports = _free_ports(2)
+    serve_args = ["--synthetic_init", "--variant", "v1", "--small",
+                  "--iters", "2", "--batch_size", "2", "--slo_ms", "100",
+                  "--bucket_multiple", "8", "--session_ttl_s", "60",
+                  "--max_queue", "64", "--warmup", "48x64", "--cpu"]
+    env = {**os.environ,
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    procs = {f"r{i}": spawn_replica(p, serve_args, env=env)
+             for i, p in enumerate(ports)}
+    router = None
+    try:
+        for i, p in enumerate(ports):
+            assert wait_ready("127.0.0.1", p, 240.0), \
+                f"replica r{i} (port {p}) never became healthy"
+        router = Router(
+            {f"r{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)},
+            port=0, config=RouterConfig(probe_interval_s=0.2,
+                                        cooldown_s=1.0,
+                                        fail_threshold=2)).start()
+        rng = np.random.default_rng(0)
+        body = encode_request(
+            rng.uniform(0, 255, (48, 64, 3)).astype(np.float32),
+            rng.uniform(0, 255, (48, 64, 3)).astype(np.float32))
+        u = urlparse(router.url)
+        n_clients, per = 4, 10
+        latencies, rejects, retries, completions = [], [], [], []
+        threads = [threading.Thread(
+            target=_client_thread,
+            args=(u.hostname, u.port, body, per, latencies, rejects,
+                  f"cam-{i}", retries, completions))
+            for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        # warm: every stream homed and ~1/3 of the traffic served
+        while len(completions) < (n_clients * per) // 3:
+            assert time.perf_counter() - t0 < 120, "load never warmed"
+            time.sleep(0.02)
+        aff_before = router.pool.affinity_record()
+        # kill the replica that OWNS a live session — killing an idle
+        # one proves nothing about affinity remap
+        victim = router.pool.ring.lookup("cam-0")
+        procs[victim].kill()        # SIGKILL mid-load: no drain, no flush
+        procs[victim].wait()
+        t_kill = time.perf_counter()
+        while (router.pool.replicas[victim].state != "open"
+               and time.perf_counter() - t_kill < 30):
+            time.sleep(0.02)
+        detect_s = time.perf_counter() - t_kill
+        for t in threads:
+            t.join(timeout=120.0)
+        aff_after = router.pool.affinity_record()
+        rec = router.stats.record()
+
+        assert router.pool.replicas[victim].state == "open", \
+            f"breaker never opened on the killed replica ({detect_s:.1f}s)"
+        assert detect_s < 10.0, \
+            f"breaker took {detect_s:.1f}s to open — recovery unbounded"
+        assert rejects == [], \
+            f"{len(rejects)} client-visible failures {rejects} — " \
+            f"in-flight requests were dropped"
+        assert len(latencies) == n_clients * per, \
+            f"only {len(latencies)}/{n_clients * per} requests completed"
+        assert aff_after["sticky_misses"] > aff_before["sticky_misses"], \
+            "victim's sessions never remapped (sticky_misses flat)"
+        print(f"    killed {victim} under load: breaker open in "
+              f"{detect_s:.2f}s, {len(latencies)}/{n_clients * per} "
+              f"requests OK (0 dropped, {len(retries)} client retries, "
+              f"{rec['failovers']} router failovers), affinity "
+              f"{aff_before['hit_rate']} -> {aff_after['hit_rate']} "
+              f"({aff_after['sticky_misses']} sticky misses)")
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
 def main() -> int:
     t_start = time.perf_counter()
     failures = []
@@ -302,6 +414,7 @@ def main() -> int:
             ("truncated-ckpt", lambda: phase_truncated_checkpoint(tmp)),
             ("kill-mid-flush", lambda: phase_kill_mid_flush(tmp)),
             ("multihost-kill", lambda: phase_multihost_kill(tmp)),
+            ("router-failover", lambda: phase_router_failover(tmp)),
         ]
         try:
             for name, fn in phases:

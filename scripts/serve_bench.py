@@ -1,6 +1,6 @@
 """Serving throughput — the eval-side analog of scripts/train_bench.py.
 
-Two modes, one watchdogged script:
+Three modes, one watchdogged script:
 
 **Engine mode** (default): drives ONE mixed-geometry frame-pair stream
 through the throughput-mode inference engine (dexiraft_tpu.serve) at
@@ -30,6 +30,18 @@ queue, SLO batching, sessions; the SERVE_r0* service record). Phases:
 The acceptance signals: ``speedup_batched_over_sequential > 1`` and
 ``warm_start.warm_beats_cold``.
 
+**Fleet mode** (``--fleet N``): spawns N ``--synthetic_init`` serve
+replica PROCESSES and drives the router (serve/router.py) over them:
+  1. goodput-vs-replica-count scaling curve (router re-pooled at each
+     k in 1..N, session clients — affinity hit rate per level),
+  2. kill-a-replica-under-load: SIGKILL one replica mid-traffic, then
+     measure breaker-detection latency, client-visible recovery gap,
+     failover retries, sticky-miss remaps, and the zero-drop check
+     (``kill.zero_dropped``: no client saw a non-200 — router failover
+     plus the client's connection-refused retry absorb the death).
+The bench process itself never imports jax: replicas own the devices.
+Record schema pinned by FLEET_RECORD_KEYS / tests/test_zzfleet_router.
+
 Watchdog (the bench.py pattern, tests/test_bench_watchdog.py /
 tests/test_zserve_bench.py): the measurement runs in a CHILD process;
 the parent kills it when it goes silent past SERVE_BENCH_STALL_S or
@@ -44,6 +56,8 @@ Usage: python scripts/serve_bench.py [--variant v1] [--small]
        python scripts/serve_bench.py --closed_loop [--size 96x128]
            [--requests 32] [--concurrency 4] [--slo_ms 150]
            [--overload_factor 4] [--warm_frames 4] [--cpu]
+       python scripts/serve_bench.py --fleet 2 [--size 64x96]
+           [--requests 48] [--concurrency 4] [--iters 2] [--cpu]
 """
 
 from __future__ import annotations
@@ -81,8 +95,27 @@ CLOSED_LOOP_RECORD_KEYS = {
 }
 LEVEL_KEYS = {
     "concurrency", "requests", "goodput_rps", "p50_ms", "p99_ms",
-    "rejected", "errors", "dispatch_full", "dispatch_slo",
-    "mean_batch_fill", "queue_peak",
+    "rejected", "errors", "client_retries", "dispatch_full",
+    "dispatch_slo", "mean_batch_fill", "queue_peak",
+}
+
+# ---- fleet (router) record schema, pinned by
+# tests/test_zzfleet_router.py --------------------------------------------
+FLEET_RECORD_KEYS = {
+    "metric", "platform", "variant", "iters", "size", "batch", "slo_ms",
+    "max_queue", "replicas", "concurrency", "requests", "scaling",
+    "kill", "goodput_scaling",
+}
+FLEET_SCALING_KEYS = {
+    "replicas", "concurrency", "requests", "goodput_rps", "p50_ms",
+    "p99_ms", "errors", "client_retries", "router_retries", "failovers",
+    "affinity_hit_rate",
+}
+FLEET_KILL_KEYS = {
+    "killed", "requests", "completed", "errors", "client_retries",
+    "detect_s", "recovery_s", "max_gap_s", "router_retries", "failovers",
+    "sticky_misses", "affinity_hit_rate_before", "affinity_hit_rate_after",
+    "zero_dropped",
 }
 OVERLOAD_KEYS = {
     "offered_rps", "duration_s", "completed", "rejected", "errors",
@@ -140,6 +173,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--warm_frames", type=int, default=4,
                     help="frames chained through one session for the "
                          "warm-start convergence check")
+    # ---- fleet (router) mode -------------------------------------------
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="spawn this many --synthetic_init serve replica "
+                         "processes and bench the router over them: "
+                         "goodput-vs-replica-count scaling, kill-a-"
+                         "replica recovery, session-affinity hit rate")
+    ap.add_argument("--boot_timeout_s", type=float, default=600.0,
+                    help="fleet replica boot bound (restore + warmup "
+                         "compile)")
     return ap
 
 
@@ -343,28 +385,65 @@ def _pctl_ms(samples, p: float) -> float:
     return round(float(np.percentile(samples, p)) * 1e3, 2)
 
 
+_CLIENT_TRIES = 4          # attempts per request (1 + up to 3 retries)
+_CLIENT_BACKOFF_S = 0.05   # doubling, jittered
+
+
 def _client_thread(host: str, port: int, body: bytes, n: int,
-                   latencies: list, rejects: list, session=None) -> None:
+                   latencies: list, rejects: list, session=None,
+                   retries: list = None, completions: list = None) -> None:
     """One closed-loop client: POST, wait for the response, repeat.
     Keep-alive (HTTP/1.1) — one connection per client, like a real
     streaming caller. Appends per-request latency (s) or the reject
-    status code; list.append is atomic, no lock needed."""
+    status code; list.append is atomic, no lock needed.
+
+    Connection-shaped failures (refused/reset — a replica restarting
+    under the client) RETRY with doubling jittered backoff instead of
+    counting as errors: a restart window is a liveness blip, not a
+    service failure, and conflating the two made every rolling restart
+    read as client errors. Each retry appends to `retries` (reported
+    separately from `rejects`); only exhausting every attempt appends
+    the sentinel -1 to `rejects`. `completions` (when given) collects
+    (t_monotonic, status) per finished request — the fleet kill leg's
+    gap/recovery analysis reads it."""
     import http.client
 
     headers = {"Content-Type": "application/x-npz"}
     if session:
         headers["X-Session-Id"] = session
+    rng = __import__("random").Random(hash((port, session)) & 0xFFFF)
     conn = http.client.HTTPConnection(host, port, timeout=60)
     try:
         for _ in range(n):
             t0 = time.monotonic()
-            conn.request("POST", "/v1/flow", body=body, headers=headers)
-            resp = conn.getresponse()
-            resp.read()
-            if resp.status == 200:
-                latencies.append(time.monotonic() - t0)
+            status = -1
+            for attempt in range(_CLIENT_TRIES):
+                try:
+                    conn.request("POST", "/v1/flow", body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    resp.read()
+                    status = resp.status
+                    break
+                except (ConnectionRefusedError, ConnectionResetError,
+                        BrokenPipeError, http.client.BadStatusLine,
+                        http.client.RemoteDisconnected):
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, port,
+                                                      timeout=60)
+                    if attempt == _CLIENT_TRIES - 1:
+                        break
+                    if retries is not None:
+                        retries.append(attempt)
+                    time.sleep(_CLIENT_BACKOFF_S * (2 ** attempt)
+                               * (1 + rng.random()))
+            now = time.monotonic()
+            if completions is not None:
+                completions.append((now, status))
+            if status == 200:
+                latencies.append(now - t0)
             else:
-                rejects.append(resp.status)
+                rejects.append(status)
     finally:
         conn.close()
 
@@ -378,11 +457,13 @@ def _run_level(service, body: bytes, concurrency: int, requests: int) -> dict:
     host, port = service.address
     latencies: list = []
     rejects: list = []
+    retries: list = []
     per = [requests // concurrency] * concurrency
     for i in range(requests % concurrency):
         per[i] += 1
     threads = [threading.Thread(target=_client_thread,
-                                args=(host, port, body, n, latencies, rejects))
+                                args=(host, port, body, n, latencies,
+                                      rejects, None, retries))
                for n in per if n]
     t0 = time.monotonic()
     for t in threads:
@@ -403,6 +484,7 @@ def _run_level(service, body: bytes, concurrency: int, requests: int) -> dict:
         "p99_ms": _pctl_ms(latencies, 99),
         "rejected": shed,
         "errors": len(rejects) - shed,
+        "client_retries": len(retries),
         "dispatch_full": sched["dispatch_full"],
         "dispatch_slo": sched["dispatch_slo"],
         "mean_batch_fill": sched["mean_batch_fill"],
@@ -628,6 +710,238 @@ def _measure_closed_loop(args) -> None:
     print(json.dumps(record), flush=True)
 
 
+# ---- fleet (router) mode ------------------------------------------------
+
+
+def _free_ports(n: int) -> list:
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _fleet_serve_args(args) -> list:
+    """Replica argv: --synthetic_init serve processes (random weights —
+    the fleet legs measure routing/failover, not EPE), warmed up on the
+    bench geometry so /healthz only answers once the compile is paid."""
+    sa = ["--synthetic_init", "--variant", args.variant,
+          "--iters", str(args.iters), "--batch_size", str(args.batch),
+          "--slo_ms", str(args.slo_ms),
+          "--max_queue", str(args.max_queue),
+          "--session_ttl_s", "60",
+          "--bucket_multiple", str(args.bucket_multiple),
+          "--warmup", args.size, "--request_timeout_s", "60"]
+    if args.small:
+        sa.append("--small")
+    if args.cpu:
+        sa.append("--cpu")
+    return sa
+
+
+def _fleet_router(urls, **overrides):
+    from dexiraft_tpu.serve.router import Router, RouterConfig
+
+    kw = dict(probe_interval_s=0.2, cooldown_s=1.0, fail_threshold=2,
+              deadline_s=60.0)
+    kw.update(overrides)
+    return Router(urls, port=0, config=RouterConfig(**kw)).start()
+
+
+def _measure_fleet(args) -> None:
+    """Router-over-N-replicas legs: (1) goodput-vs-replica-count
+    scaling curve, (2) kill-one-replica-under-load — recovery
+    wall-time, zero-drop check, affinity hit rate before/after. The
+    bench process itself NEVER imports jax: replicas own the devices
+    (N processes cannot share one TPU chip), and the router/clients are
+    pure control plane."""
+    import threading
+    from urllib.parse import urlparse
+
+    from dexiraft_tpu.router_cli import spawn_replica, wait_ready
+    from dexiraft_tpu.serve.server import encode_request
+
+    import numpy as np
+
+    h, w = (int(v) for v in args.size.split("x"))
+    rng = np.random.default_rng(0)
+    body = encode_request(
+        rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+        rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+
+    n = args.fleet
+    if n < 2:
+        raise SystemExit("--fleet needs >= 2 replicas (the kill leg "
+                         "must have a survivor)")
+    ports = _free_ports(n)
+    serve_args = _fleet_serve_args(args)
+    procs = {f"r{i}": spawn_replica(p, serve_args)
+             for i, p in enumerate(ports)}
+    urls = {f"r{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+    platform = "cpu" if args.cpu else os.environ.get("JAX_PLATFORMS",
+                                                     "default")
+
+    def run_clients(url, concurrency, per, prefix, completions=None):
+        u = urlparse(url)
+        latencies, rejects, retries = [], [], []
+        threads = [threading.Thread(
+            target=_client_thread,
+            args=(u.hostname, u.port, body, per, latencies, rejects,
+                  f"{prefix}-{i}", retries, completions))
+            for i in range(concurrency)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        return threads, latencies, rejects, retries, t0
+
+    try:
+        for i, p in enumerate(ports):
+            if not wait_ready("127.0.0.1", p, args.boot_timeout_s):
+                raise RuntimeError(f"replica r{i} (port {p}) not healthy "
+                                   f"within {args.boot_timeout_s:g}s")
+            print(f"[fleet] replica r{i} healthy on port {p}",
+                  file=sys.stderr, flush=True)
+
+        per = max(1, args.requests // args.concurrency)
+
+        # -- leg 1: goodput-vs-replica-count scaling curve ----------------
+        scaling = []
+        for k in range(1, n + 1):
+            router = _fleet_router({r: urls[r] for r in list(urls)[:k]})
+            threads, lat, rej, ret, t0 = run_clients(
+                router.url, args.concurrency, per, f"scale{k}")
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t0
+            rec = router.stats.record()
+            aff = router.pool.affinity_record()
+            router.stop()
+            entry = {
+                "replicas": k,
+                "concurrency": args.concurrency,
+                "requests": per * args.concurrency,
+                "goodput_rps": round(len(lat) / wall, 3) if wall else 0.0,
+                "p50_ms": _pctl_ms(lat, 50),
+                "p99_ms": _pctl_ms(lat, 99),
+                "errors": len(rej),
+                "client_retries": len(ret),
+                "router_retries": rec["retries"],
+                "failovers": rec["failovers"],
+                "affinity_hit_rate": aff["hit_rate"],
+            }
+            scaling.append(entry)
+            print(f"[fleet k={k}] {entry['goodput_rps']} req/s, p50 "
+                  f"{entry['p50_ms']} / p99 {entry['p99_ms']} ms, "
+                  f"affinity {entry['affinity_hit_rate']}",
+                  file=sys.stderr)
+
+        # -- leg 2: kill one replica under load ---------------------------
+        router = _fleet_router(urls)
+        completions: list = []
+        kill_per = max(3, per)
+        total = kill_per * args.concurrency
+        threads, lat, rej, ret, t0 = run_clients(
+            router.url, args.concurrency, kill_per, "kill", completions)
+        # let the fleet warm (sessions homed, ~1/3 of traffic served) …
+        while len(completions) < max(args.concurrency, total // 3):
+            if time.monotonic() - t0 > 300:
+                raise RuntimeError("kill leg warm phase stalled")
+            time.sleep(0.02)
+        aff_before = router.pool.affinity_record()
+        # kill the replica that OWNS the first kill-stream's session —
+        # a session-less victim would make the sticky-miss/remap
+        # numbers vacuous
+        victim = router.pool.ring.lookup("kill-0")
+        procs[victim].kill()          # SIGKILL: abrupt death, no drain
+        procs[victim].wait()
+        t_kill = time.monotonic()
+        print(f"[fleet] killed {victim} after {len(completions)}/{total} "
+              f"requests", file=sys.stderr)
+        while (router.pool.replicas[victim].state != "open"
+               and time.monotonic() - t_kill < 60):
+            time.sleep(0.02)
+        detect_s = time.monotonic() - t_kill
+        for t in threads:
+            t.join()
+        aff_end = router.pool.affinity_record()
+        rec = router.stats.record()
+        router.stop()
+
+        succ = sorted(t for t, s in completions if s == 200)
+        post = [t for t in succ if t >= t_kill]
+        gaps = [b - a for a, b in zip(succ, succ[1:])]
+        hits_d = aff_end["hits"] - aff_before["hits"]
+        miss_d = aff_end["sticky_misses"] - aff_before["sticky_misses"]
+        kill = {
+            "killed": victim,
+            "requests": total,
+            "completed": len(succ),
+            "errors": len(rej),
+            "client_retries": len(ret),
+            # breaker-open latency (the router stopped ASSIGNING to the
+            # corpse this fast; individual requests failed over earlier
+            # via the passive path)
+            "detect_s": round(detect_s, 3),
+            # first successful completion after the kill — the client-
+            # visible service gap
+            "recovery_s": (round(post[0] - t_kill, 3) if post else None),
+            "max_gap_s": (round(max(gaps), 3) if gaps else None),
+            "router_retries": rec["retries"],
+            "failovers": rec["failovers"],
+            "sticky_misses": aff_end["sticky_misses"],
+            "affinity_hit_rate_before": aff_before["hit_rate"],
+            "affinity_hit_rate_after": (
+                round(hits_d / (hits_d + miss_d), 4)
+                if hits_d + miss_d else None),
+            "zero_dropped": len(rej) == 0,
+        }
+        print(f"[fleet kill] detect {kill['detect_s']}s, recovery "
+              f"{kill['recovery_s']}s, {kill['errors']} errors / "
+              f"{kill['client_retries']} client retries / "
+              f"{kill['failovers']} failovers, affinity "
+              f"{kill['affinity_hit_rate_before']} -> "
+              f"{kill['affinity_hit_rate_after']}", file=sys.stderr)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    record = {
+        "metric": "serve_fleet",
+        "platform": platform,
+        "variant": args.variant + ("-small" if args.small else ""),
+        "iters": args.iters,
+        "size": args.size,
+        "batch": args.batch,
+        "slo_ms": args.slo_ms,
+        "max_queue": args.max_queue,
+        "replicas": n,
+        "concurrency": args.concurrency,
+        "requests": args.requests,
+        "scaling": scaling,
+        "kill": kill,
+        "goodput_scaling": (
+            round(scaling[-1]["goodput_rps"] / scaling[0]["goodput_rps"],
+                  3) if scaling[0]["goodput_rps"] else None),
+    }
+    assert set(record) == FLEET_RECORD_KEYS, \
+        sorted(set(record) ^ FLEET_RECORD_KEYS)
+    assert all(set(s) == FLEET_SCALING_KEYS for s in scaling)
+    assert set(kill) == FLEET_KILL_KEYS, sorted(set(kill) ^ FLEET_KILL_KEYS)
+    print(json.dumps(record), flush=True)
+
+
 def main() -> int:
     """Parent: spawn the measurement child under the stall watchdog.
     No jax import on this side — a wedged backend can only hang the
@@ -705,6 +1019,11 @@ if __name__ == "__main__":
             while True:
                 time.sleep(3600)
         _args = build_parser().parse_args()
+        if _args.fleet:
+            # fleet mode never imports jax in this process (replicas
+            # own the devices); --cpu is forwarded to them instead
+            _measure_fleet(_args)
+            sys.exit(0)
         if _args.cpu:
             import jax
 

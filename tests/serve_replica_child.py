@@ -1,0 +1,50 @@
+"""Stub FlowService replica for the fleet-router subprocess tests.
+
+A REAL serve process (HTTP listener, scheduler, sessions — the full
+service stack) over the numpy stub eval_fn, so it boots in ~a second
+(no model, no checkpoint, no compile) and SIGKILLing it is a genuine
+process death: connections reset, the port goes dark, warm session
+carries vanish. tests/test_zzfleet_router.py and nothing else runs
+this.
+
+Usage: python tests/serve_replica_child.py PORT
+"""
+
+import sys
+
+import numpy as np
+
+from dexiraft_tpu.serve import FlowService, InferenceEngine, ServeConfig
+
+
+def stub_eval(im1, im2, flow_init=None):
+    """test_zzserve_service's carry-accumulating stub: constant
+    (2, -1) flow; warm rows add their flow_init so affinity is
+    OBSERVABLE in the responses, not just in counters."""
+    b, h, w = im1.shape[:3]
+    up = np.broadcast_to(np.float32([2.0, -1.0]), (b, h, w, 2)).copy()
+    low = np.full((b, h // 8, w // 8, 2), 0.5, np.float32)
+    if flow_init is not None:
+        fi = np.asarray(flow_init)
+        up = up + np.repeat(np.repeat(fi, 8, 1), 8, 2)
+        low = low + fi
+    return low, up
+
+
+def main() -> None:
+    port = int(sys.argv[1])
+    svc = FlowService(
+        InferenceEngine(stub_eval,
+                        ServeConfig(batch_size=2, warm_start=True),
+                        put=lambda t: t),
+        host="127.0.0.1", port=port, slo_ms=30.0, max_queue=32,
+        session_ttl_s=60.0)
+    svc.install_signal_handlers()
+    svc.start()
+    print(f"[replica] listening on {svc.url}", flush=True)
+    while not svc.stopped.wait(0.5):
+        pass
+
+
+if __name__ == "__main__":
+    main()

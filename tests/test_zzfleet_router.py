@@ -1,0 +1,587 @@
+"""Fleet router (dexiraft_tpu/serve/router.py): hash-ring bounded
+remapping, the circuit-breaker state machine, drain-waits-for-inflight,
+failover-retry-once semantics (all fake-clock / fake-prober — no
+sockets, deterministic), the /stats record schemas, and ONE real
+router-over-2-subprocess-replicas HTTP test (SIGKILL a replica under
+session traffic: zero 5xx beyond the in-flight window, sessions remap).
+
+Named test_zz* to sort after the long-standing tail tests (870 s
+budget convention); the subprocess test is the only non-instant piece
+and stays well under the per-test ceiling.
+"""
+
+import json
+import os
+import os.path as osp
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dexiraft_tpu.serve.router import (CLOSED, HALF_OPEN, OPEN, HashRing,
+                                       NoHealthyReplica, ReplicaPool,
+                                       Router, RouterConfig)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---- hash ring: bounded remapping ---------------------------------------
+
+
+KEYS = [f"session-{i}" for i in range(256)]
+
+
+class TestHashRing:
+    def test_lookup_deterministic_and_covers_members(self):
+        ring = HashRing(["a", "b", "c"])
+        owners = {k: ring.lookup(k) for k in KEYS}
+        assert owners == {k: ring.lookup(k) for k in KEYS}  # stable
+        assert set(owners.values()) == {"a", "b", "c"}      # all used
+
+    def test_add_moves_only_a_bounded_share_and_only_to_the_new_member(self):
+        ring = HashRing(["a", "b", "c"])
+        before = {k: ring.lookup(k) for k in KEYS}
+        ring.add("d")
+        after = {k: ring.lookup(k) for k in KEYS}
+        moved = [k for k in KEYS if before[k] != after[k]]
+        # consistent hashing's defining property: every moved key moved
+        # TO the new member (nothing reshuffles between survivors) …
+        assert all(after[k] == "d" for k in moved)
+        # … and the moved share is ~1/(N+1), strictly bounded below 1/2
+        assert 0 < len(moved) / len(KEYS) < 0.5
+
+    def test_remove_moves_only_the_departed_members_keys(self):
+        ring = HashRing(["a", "b", "c"])
+        before = {k: ring.lookup(k) for k in KEYS}
+        ring.remove("b")
+        after = {k: ring.lookup(k) for k in KEYS}
+        for k in KEYS:
+            if before[k] != "b":
+                assert after[k] == before[k]    # survivors keep theirs
+            else:
+                assert after[k] in ("a", "c")   # b's keys re-home
+        # add it back: its keys return (sessions come home after a
+        # replica recovers)
+        ring.add("b")
+        assert {k: ring.lookup(k) for k in KEYS} == before
+
+    def test_chain_starts_at_owner_and_covers_all(self):
+        ring = HashRing(["a", "b", "c"])
+        for k in KEYS[:16]:
+            chain = ring.chain(k)
+            assert chain[0] == ring.lookup(k)
+            assert sorted(chain) == ["a", "b", "c"]
+
+    def test_empty_ring(self):
+        ring = HashRing()
+        assert ring.lookup("x") is None and ring.chain("x") == []
+
+
+# ---- pool: breaker state machine (fake clock, fake prober) --------------
+
+
+def make_pool(n=2, *, payloads=None, **cfg_kw):
+    """Pool over fake replicas; `payloads[rid]` is the prober's answer
+    (a dict) or an Exception to raise. Tests mutate it live."""
+    clock = FakeClock()
+    payloads = payloads if payloads is not None else {
+        f"r{i}": {"_status": 200, "draining": False, "inflight": 0}
+        for i in range(n)}
+
+    def prober(replica):
+        v = payloads[replica.rid]
+        if isinstance(v, Exception):
+            raise v
+        return dict(v)
+
+    pool = ReplicaPool(
+        {f"r{i}": f"127.0.0.1:{9000 + i}" for i in range(n)},
+        RouterConfig(fail_threshold=3, cooldown_s=5.0,
+                     probe_interval_s=1.0, vnodes=16),
+        clock=clock, prober=prober, sleep=lambda s: clock.advance(s))
+    return pool, clock, payloads
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        pool, clock, _ = make_pool()
+        pool.mark_failure("r0")
+        pool.mark_failure("r0")
+        assert pool.replicas["r0"].state == CLOSED    # 2 < threshold 3
+        assert "r0" in pool.ring.members
+        pool.mark_failure("r0")
+        assert pool.replicas["r0"].state == OPEN
+        assert "r0" not in pool.ring.members          # out of assignment
+        assert pool.breaker_opens == 1
+
+    def test_success_resets_the_consecutive_count(self):
+        pool, clock, payloads = make_pool()
+        pool.mark_failure("r0")
+        pool.mark_failure("r0")
+        pool.mark_alive("r0", payloads["r0"])
+        pool.mark_failure("r0")
+        pool.mark_failure("r0")
+        assert pool.replicas["r0"].state == CLOSED    # count restarted
+
+    def test_open_cooldown_then_half_open_probe_decides(self):
+        pool, clock, payloads = make_pool()
+        payloads["r0"] = ConnectionRefusedError("down")
+        for _ in range(3):
+            pool.mark_failure("r0")
+        assert pool.replicas["r0"].state == OPEN
+        opened_at = pool.replicas["r0"].opened_at
+
+        # inside the cooldown: probe sweeps must NOT touch it
+        clock.advance(1.0)
+        pool.probe_once()
+        assert pool.replicas["r0"].state == OPEN
+        assert pool.replicas["r0"].opened_at == opened_at
+
+        # cooldown over: the half-open trial probe fails -> re-open
+        # with a FRESH cooldown window
+        clock.advance(5.0)
+        pool.probe_once()
+        assert pool.replicas["r0"].state == OPEN
+        assert pool.replicas["r0"].opened_at > opened_at
+
+        # next cooldown: the trial succeeds -> closed, back in the ring
+        payloads["r0"] = {"_status": 200, "draining": False, "inflight": 0}
+        clock.advance(5.5)
+        pool.probe_once()
+        assert pool.replicas["r0"].state == CLOSED
+        assert "r0" in pool.ring.members
+
+    def test_half_open_receives_no_client_traffic(self):
+        pool, clock, payloads = make_pool()
+        for _ in range(3):
+            pool.mark_failure("r0")
+        clock.advance(6.0)
+        pool.replicas["r0"].state = HALF_OPEN   # mid-trial snapshot
+        for _ in range(8):
+            assert pool.route(None).rid == "r1"
+
+    def test_draining_replica_is_alive_but_not_routable(self):
+        pool, clock, _ = make_pool()
+        pool.mark_alive("r0", {"_status": 503, "draining": True,
+                               "inflight": 4})
+        r = pool.replicas["r0"]
+        assert r.state == CLOSED and not r.ready and not r.routable()
+        assert "r0" not in pool.ring.members
+        assert r.fails == 0         # deliberate drain != failure
+        # readiness returns -> routable again
+        pool.mark_alive("r0", {"_status": 200, "draining": False,
+                               "inflight": 0})
+        assert pool.replicas["r0"].routable()
+        assert "r0" in pool.ring.members
+
+    def test_probe_interval_respected(self):
+        pool, clock, payloads = make_pool()
+        calls = []
+        orig = pool.prober
+
+        def counting(replica):
+            calls.append(replica.rid)
+            return orig(replica)
+
+        pool.prober = counting
+        pool.probe_once()
+        pool.probe_once()               # same instant: nothing due
+        assert len(calls) == 2          # one sweep probed both once
+        clock.advance(1.1)
+        pool.probe_once()
+        assert len(calls) == 4
+
+
+class TestRoutingAffinity:
+    def test_session_routes_to_ring_owner_until_it_dies(self):
+        pool, clock, _ = make_pool(3)
+        sid = "cam-0"
+        owner = pool.route(sid).rid
+        for _ in range(4):
+            assert pool.route(sid).rid == owner
+        assert pool.affinity_hits == 4 and pool.sticky_misses == 0
+
+        for _ in range(3):              # owner dies
+            pool.mark_failure(owner)
+        moved = pool.route(sid).rid
+        assert moved != owner
+        assert pool.sticky_misses == 1  # cold restart elsewhere, counted
+        assert pool.route(sid).rid == moved
+        assert pool.affinity_hits == 5  # sticky again on the new home
+
+    def test_stateless_round_robin(self):
+        pool, clock, _ = make_pool(3)
+        seen = {pool.route(None).rid for _ in range(6)}
+        assert seen == {"r0", "r1", "r2"}
+
+    def test_no_healthy_raises(self):
+        pool, clock, _ = make_pool(2)
+        for rid in ("r0", "r1"):
+            for _ in range(3):
+                pool.mark_failure(rid)
+        with pytest.raises(NoHealthyReplica):
+            pool.route("cam-0")
+
+    def test_alternate_excludes_and_follows_chain(self):
+        pool, clock, _ = make_pool(3)
+        sid = "cam-1"
+        chain = pool.ring.chain(sid)
+        alt = pool.alternate(chain[0], sid)
+        assert alt is not None and alt.rid == chain[1]
+        assert pool.alternate("r0", None).rid != "r0"
+
+
+class TestDrain:
+    def test_drain_waits_for_inflight_then_restarts(self):
+        pool, clock, payloads = make_pool()
+        inflight = [3, 2, 1, 0]
+        restarted = []
+        pool.replicas["r0"].restart = lambda: restarted.append(clock())
+
+        def draining_prober(replica):
+            n = inflight.pop(0) if inflight else 0
+            return {"_status": 503, "draining": True, "inflight": n}
+
+        pool.prober = draining_prober
+        out = pool.drain("r0", timeout_s=60.0, poll_s=1.0)
+        assert out["drained"] is True
+        assert out["inflight_last"] == 0
+        assert restarted == [out["waited_s"]]   # hook ran AFTER inflight 0
+        assert out["waited_s"] == 3.0           # three 1 s polls
+        r = pool.replicas["r0"]
+        assert not r.draining                   # lifecycle flag released
+        assert "r0" not in pool.ring.members    # until it probes ready
+        pool.mark_alive("r0", {"_status": 200, "draining": False,
+                               "inflight": 0})
+        assert "r0" in pool.ring.members
+
+    def test_drain_timeout_never_restarts_busy_replica(self):
+        pool, clock, payloads = make_pool()
+        restarted = []
+        pool.replicas["r0"].restart = lambda: restarted.append(1)
+        pool.prober = lambda r: {"_status": 200, "draining": False,
+                                 "inflight": 5}
+        out = pool.drain("r0", timeout_s=3.0, poll_s=1.0)
+        assert out["drained"] is False and out["inflight_last"] == 5
+        assert restarted == []      # zero-drop: no restart over live work
+
+    def test_dead_replica_drains_immediately(self):
+        pool, clock, payloads = make_pool()
+        pool.prober = lambda r: (_ for _ in ()).throw(
+            ConnectionRefusedError("gone"))
+        out = pool.drain("r0", timeout_s=10.0)
+        assert out["drained"] is True and out["waited_s"] == 0.0
+
+
+# ---- failover-retry-once semantics (patched upstream, no sockets) -------
+
+
+def make_router(n=2, *, clock=None, **cfg_kw):
+    cfg_kw.setdefault("retry_backoff_s", 0.0)
+    cfg_kw.setdefault("vnodes", 16)
+    router = Router({f"r{i}": f"127.0.0.1:{9100 + i}" for i in range(n)},
+                    port=0, config=RouterConfig(**cfg_kw),
+                    clock=clock or time.monotonic)
+    return router
+
+
+class _Up:
+    """Scripted upstream: pops the next outcome per call; an Exception
+    outcome is raised. Records which replica each attempt hit."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)
+        self.hits = []
+
+    def __call__(self, replica, body, session_id, content_type, timeout):
+        self.hits.append(replica.rid)
+        out = self.outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        from dexiraft_tpu.serve.router import _UpstreamResult
+
+        return _UpstreamResult(out, b"{}", {})
+
+
+class TestFailoverRetryOnce:
+    def test_connect_refused_retries_once_on_a_different_replica(self):
+        router = make_router()
+        try:
+            up = _Up([ConnectionRefusedError("dead"), 200])
+            router._upstream = up
+            status, body, headers = router.proxy_flow(b"x", "cam-0",
+                                                      "application/x-npz")
+            assert status == 200
+            assert len(up.hits) == 2 and up.hits[0] != up.hits[1]
+            assert headers["X-Router-Retries"] == "1"
+            assert headers["X-Replica"] == up.hits[1]
+            rec = router.stats.record()
+            assert rec["retries"] == 1 and rec["failovers"] == 1
+            # the failed attempt fed the breaker (passive marking)
+            assert router.pool.replicas[up.hits[0]].fails == 1
+        finally:
+            router._httpd.server_close()
+
+    def test_exactly_one_retry_then_502(self):
+        router = make_router()
+        try:
+            up = _Up([ConnectionRefusedError("a"),
+                      ConnectionRefusedError("b"),
+                      200])   # a third attempt would consume this
+            router._upstream = up
+            status, _, _ = router.proxy_flow(b"x", "cam-0",
+                                             "application/x-npz")
+            assert status == 502
+            assert len(up.hits) == 2          # retry-ONCE, not until-success
+            assert router.stats.record()["upstream_errors"] == 1
+        finally:
+            router._httpd.server_close()
+
+    def test_both_replicas_shedding_surfaces_503_not_502(self):
+        router = make_router()
+        try:
+            up = _Up([503, 503])
+            router._upstream = up
+            status, _, headers = router.proxy_flow(b"x", None,
+                                                   "application/x-npz")
+            assert status == 503
+            assert headers.get("Retry-After") == "1"
+            rec = router.stats.record()
+            assert rec["shed_upstream"] == 1 and rec["upstream_errors"] == 0
+            # shedding is load, not failure: no breaker input
+            assert all(r.fails == 0
+                       for r in router.pool.replicas.values())
+        finally:
+            router._httpd.server_close()
+
+    def test_deadline_budget_exhausted_is_504(self):
+        clock = FakeClock()
+        router = make_router(clock=clock, deadline_s=1.0)
+        try:
+            def slow_upstream(replica, body, sid, ct, timeout):
+                clock.advance(2.0)      # burn past the deadline
+                raise ConnectionResetError("mid-flight kill")
+
+            router._upstream = slow_upstream
+            status, body, _ = router.proxy_flow(b"x", "cam-0",
+                                                "application/x-npz")
+            assert status == 504
+            assert b"deadline" in body
+        finally:
+            router._httpd.server_close()
+
+    def test_router_admission_bound_sheds_503(self):
+        router = make_router(max_inflight=1)
+        try:
+            router._inflight = 1    # simulate one request parked inside
+            status, _, headers = router.proxy_flow(b"x", None,
+                                                   "application/x-npz")
+            assert status == 503 and headers["Retry-After"] == "1"
+            assert router.stats.record()["shed_router"] == 1
+        finally:
+            router._inflight = 0
+            router._httpd.server_close()
+
+    def test_no_healthy_replica_is_503(self):
+        router = make_router()
+        try:
+            for rid in list(router.pool.replicas):
+                for _ in range(3):
+                    router.pool.mark_failure(rid)
+            status, _, _ = router.proxy_flow(b"x", None,
+                                             "application/x-npz")
+            assert status == 503
+            assert router.stats.record()["no_healthy"] == 1
+        finally:
+            router._httpd.server_close()
+
+
+# ---- record schemas (the /stats and bench contracts) --------------------
+
+
+ROUTER_KEYS = {"requests", "proxied_ok", "retries", "failovers",
+               "shed_router", "shed_upstream", "upstream_errors",
+               "no_healthy", "latency_p50_ms", "latency_p99_ms"}
+POOL_KEYS = {"replicas", "healthy", "ring_members", "breaker_opens",
+             "drains", "affinity"}
+AFFINITY_KEYS = {"hits", "new", "sticky_misses", "hit_rate"}
+AUTOSCALE_KEYS = {"recommendation", "healthy", "shed_window",
+                  "queue_depths"}
+REPLICA_KEYS = {"url", "state", "ready", "draining",
+                "consecutive_failures", "health"}
+
+
+def test_router_stats_schema_pinned():
+    router = make_router()
+    try:
+        rec = router.stats_record()
+        assert set(rec) == {"router", "pool", "autoscale"}
+        assert set(rec["router"]) == ROUTER_KEYS
+        assert set(rec["pool"]) == POOL_KEYS
+        assert set(rec["pool"]["affinity"]) == AFFINITY_KEYS
+        assert set(rec["autoscale"]) == AUTOSCALE_KEYS
+        for r in rec["pool"]["replicas"].values():
+            assert set(r) == REPLICA_KEYS
+    finally:
+        router._httpd.server_close()
+
+
+def test_autoscale_recommendation_rules():
+    router = make_router()
+    try:
+        assert (router._autoscale_record()["recommendation"]
+                == "scale_down")            # idle window, >1 routable
+        router.stats.requests = 10
+        assert router._autoscale_record()["recommendation"] == "steady"
+        router.stats.shed_router = 1
+        assert router._autoscale_record()["recommendation"] == "scale_up"
+        # windows are SINCE-LAST-SCRAPE deltas, not lifetime counters:
+        # one ancient shed must not latch scale_up forever, and an
+        # idle window after traffic must still reach scale_down
+        rec = router._autoscale_record()
+        assert rec["recommendation"] == "scale_down"
+        assert rec["shed_window"] == 0
+    finally:
+        router._httpd.server_close()
+
+
+def test_fleet_bench_record_schemas_pinned():
+    sys.path.insert(0, osp.join(REPO, "scripts"))
+    try:
+        from serve_bench import (FLEET_KILL_KEYS, FLEET_RECORD_KEYS,
+                                 FLEET_SCALING_KEYS, LEVEL_KEYS)
+    finally:
+        sys.path.pop(0)
+    assert {"metric", "replicas", "scaling", "kill",
+            "goodput_scaling"} <= FLEET_RECORD_KEYS
+    assert {"replicas", "goodput_rps", "affinity_hit_rate",
+            "client_retries"} <= FLEET_SCALING_KEYS
+    assert {"killed", "detect_s", "recovery_s", "zero_dropped",
+            "sticky_misses", "affinity_hit_rate_before",
+            "affinity_hit_rate_after"} <= FLEET_KILL_KEYS
+    # the closed-loop client now reports restart-window retries
+    # separately from errors
+    assert "client_retries" in LEVEL_KEYS
+
+
+# ---- the real thing: router over 2 subprocess replicas ------------------
+
+
+def _free_ports(n):
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _post(url, body, session=None, timeout=15.0):
+    headers = {"Content-Type": "application/x-npz"}
+    if session:
+        headers["X-Session-Id"] = session
+    req = urllib.request.Request(url + "/v1/flow", data=body,
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers)
+
+
+class TestRouterOverSubprocessReplicas:
+    def test_kill_one_replica_sessions_remap_no_5xx(self):
+        from dexiraft_tpu.router_cli import wait_ready
+        from dexiraft_tpu.serve.server import encode_request
+
+        child = osp.join(REPO, "tests", "serve_replica_child.py")
+        env = {**os.environ,
+               "PYTHONPATH": REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        ports = _free_ports(2)
+        procs = {f"r{i}": subprocess.Popen(
+            [sys.executable, child, str(p)], env=env,
+            start_new_session=True) for i, p in enumerate(ports)}
+        router = None
+        try:
+            for i, p in enumerate(ports):
+                assert wait_ready("127.0.0.1", p, 60.0), \
+                    f"stub replica r{i} (port {p}) never became healthy"
+            router = Router(
+                {f"r{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)},
+                port=0,
+                config=RouterConfig(probe_interval_s=0.1, cooldown_s=0.5,
+                                    fail_threshold=2,
+                                    retry_backoff_s=0.01)).start()
+            rng = np.random.default_rng(0)
+            body = encode_request(
+                rng.uniform(0, 255, (40, 56, 3)).astype(np.float32),
+                rng.uniform(0, 255, (40, 56, 3)).astype(np.float32))
+
+            sessions = [f"s-{i}" for i in range(4)]
+            served_by = {}
+            for k in range(3):
+                for sid in sessions:
+                    status, hdr = _post(router.url, body, session=sid)
+                    assert status == 200
+                    if k:   # same replica as last time = affinity held
+                        assert hdr["X-Replica"] == served_by[sid]
+                    served_by[sid] = hdr["X-Replica"]
+            assert router.pool.affinity_record()["hit_rate"] == 1.0
+
+            # SIGKILL the replica owning s-0: a REAL process death
+            victim = served_by["s-0"]
+            procs[victim].kill()
+            procs[victim].wait()
+
+            # every later request still answers 200 — the in-flight
+            # window is absorbed by the router's failover retry
+            survivor_serves = []
+            for k in range(3):
+                for sid in sessions:
+                    status, hdr = _post(router.url, body, session=sid)
+                    assert status == 200, \
+                        f"5xx after the in-flight window ({sid}, {k})"
+                    survivor_serves.append(hdr["X-Replica"])
+            assert victim not in survivor_serves    # remapped away
+            rec = router.stats.record()
+            assert rec["upstream_errors"] == 0
+            assert rec["failovers"] >= 1            # the kill was absorbed
+            aff = router.pool.affinity_record()
+            assert aff["sticky_misses"] >= 1        # remap counted
+            assert router.pool.replicas[victim].state == OPEN
+
+            # the router's own health stays green on the survivor
+            with urllib.request.urlopen(router.url + "/healthz",
+                                        timeout=5.0) as r:
+                health = json.load(r)
+            assert health["healthy"] == 1
+        finally:
+            if router is not None:
+                router.stop()
+            for p in procs.values():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs.values():
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()

@@ -148,9 +148,26 @@ class TestSchedulerPolicy:
             "submitted", "completed", "failed", "rejected",
             "dispatch_full", "dispatch_slo", "dispatch_drain",
             "queue_peak", "mean_batch_fill", "wait_p50_ms", "wait_p99_ms",
-            "latency_p50_ms", "latency_p99_ms", "queue_depth", "slo_ms",
-            "max_queue", "service_est_ms", "draining",
+            "latency_p50_ms", "latency_p99_ms", "queue_depth", "inflight",
+            "slo_ms", "max_queue", "service_est_ms", "draining",
         }
+
+    def test_inflight_counts_queued_and_mid_dispatch(self):
+        """The /healthz readiness payload's `inflight` must cover a
+        batch that LEFT the queue but hasn't answered yet — that is
+        exactly the window a router's zero-drop drain waits out."""
+        clock = FakeClock()
+        s = Scheduler(_engine(2), slo_ms=1000.0, clock=clock)
+        seen = []
+        s.post_dispatch = lambda bucket, results: seen.append(s.inflight())
+        s.submit_async(_item())
+        s.submit_async(_item())
+        assert s.inflight() == 2 and s.queue_depth() == 2
+        assert s.poll_once()
+        # inside the dispatch (post_dispatch hook) the queue was empty
+        # but both requests still counted as in flight
+        assert seen == [2]
+        assert s.inflight() == 0 and s.queue_depth() == 0
 
 
 class TestSchedulerLifecycle:
@@ -292,8 +309,15 @@ class TestHTTPService:
         _post(service.url, body)
         status, health = _get_json(service.url, "/healthz")
         assert status == 200
-        assert set(health) == {"status", "uptime_s", "queue_depth"}
+        # liveness/readiness split: the readiness payload must let a
+        # router distinguish "dying" from "busy" and poll a drain down
+        assert set(health) == {"status", "draining", "inflight",
+                               "sessions", "uptime_s", "queue_depth"}
         assert health["status"] == "ok"
+        assert health["draining"] is False
+        assert health["inflight"] == 0 and health["sessions"] == 0
+        status, live = _get_json(service.url, "/livez")
+        assert status == 200 and live == {"status": "alive"}
 
         status, stats = _get_json(service.url, "/stats?reset=1")
         assert set(stats) == {"service", "engine", "scheduler", "sessions"}
@@ -402,6 +426,10 @@ class TestHTTPService:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _get_json(svc.url, "/healthz")
             assert ei.value.code == 503
+            # … but liveness holds: draining is not dead (the router
+            # restarts on /livez, routes on /healthz)
+            status, live = _get_json(svc.url, "/livez")
+            assert status == 200 and live["status"] == "alive"
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _post(svc.url, body)
             assert ei.value.code == 503
