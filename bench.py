@@ -39,7 +39,7 @@ BENCH_RECORD_KEYS = frozenset({
     "corr_impl", "corr_dtype", "fused_update", "dexined_upconv",
     "loop_only_iters_per_sec", "loop_only_vs_whole_forward_baseline",
     "allpairs_iters_per_sec", "local_corr_iters_per_sec",
-    "pallas_corr_iters_per_sec",
+    "pallas_corr_iters_per_sec", "flash_corr_iters_per_sec",
 })
 BENCH_RECORD_OPTIONAL_KEYS = frozenset({
     "cpu_anchor_flax_over_torch", "cpu_anchor_flax_over_torch_train",
@@ -48,7 +48,7 @@ BENCH_RECORD_OPTIONAL_KEYS = frozenset({
 })
 # every sweep leg's diagnostics land under its tag prefix
 BENCH_DIAG_PREFIXES = (
-    "allpairs", "local", "pallas", "fused_pallas",
+    "allpairs", "local", "pallas", "fused_pallas", "flash",
 )
 
 
@@ -582,6 +582,12 @@ def main() -> None:
                 ("allpairs", "subpixel", "int8", False, "allpairs_int8"),
                 ("pallas", "subpixel", "fp32", True, "fused_pallas"),
                 ("pallas", "subpixel", "int8", True, "fused_pallas_int8"),
+                # ISSUE 12's flash-blocked legs: ONE kernel/iteration,
+                # fmap2 row-block-streamed from HBM, no materialized
+                # volume and no VMEM split path — the candidate for a
+                # third allpairs-vs-local ordering flip
+                ("flash", "subpixel", "fp32", True, "flash"),
+                ("flash", "subpixel", "int8", True, "flash_int8"),
                 ("allpairs", "transpose", "fp32", False,
                  "allpairs_transpose"),
                 ("local", "transpose", "fp32", False, "local_transpose")):
@@ -613,8 +619,9 @@ def main() -> None:
     # chip bf16 peak. Reported only when both the FLOP count and a
     # known chip peak exist; the record names both inputs.
     if fused_best:
-        win_tag = "fused_pallas" + ("" if dtype_best == "fp32"
-                                    else f"_{dtype_best}")
+        base = "flash" if impl == "flash" else "fused_pallas"
+        win_tag = base + ("" if dtype_best == "fp32"
+                          else f"_{dtype_best}")
     elif dtype_best != "fp32":
         win_tag = f"{impl}_{dtype_best}"
     else:
@@ -698,6 +705,7 @@ def main() -> None:
         "allpairs_iters_per_sec": round(allpairs_ips, 2),
         "local_corr_iters_per_sec": local_ips,
         "pallas_corr_iters_per_sec": diag.get("pallas_iters_per_sec"),
+        "flash_corr_iters_per_sec": diag.get("flash_iters_per_sec"),
         **diag,
     }
     validate_record(rec)  # schema pin — a drifted record fails loudly

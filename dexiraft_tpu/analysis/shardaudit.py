@@ -11,10 +11,12 @@ resolved sharding off the compiled executables, and
     (``analysis/layout_golden.json``) — ANY drift is a nonzero exit, so
     a silently changed spec fails CI the same way a lint finding does;
   * resolves the layout's *declared* array groups (batch, carry, and
-    the ~200 MB all-pairs correlation volume — the canary) at the
-    production reference geometry and flags any group over a size
-    threshold that resolves fully replicated and is not pinned as
-    replicated-by-design in ``parallel.layout.REPLICATED_OK``.
+    the on-demand correlation fmap set — the canary, now that the
+    flash-blocked kernel killed the materialized all-pairs volume in
+    the production eval/serve config) at the production reference
+    geometry and flags any group over a size threshold that resolves
+    fully replicated and is not pinned as replicated-by-design in
+    ``parallel.layout.REPLICATED_OK``.
 
 Run it via ``scripts/shard_audit.py`` (which forces the host platform
 before jax initializes); the tier-1 verify command runs it right after
@@ -151,7 +153,17 @@ def audit_train(mesh=None) -> Dict[str, Any]:
 
 def _audit_eval_step(mesh) -> Dict[str, Any]:
     """Shared body for the eval and serve audits — same forward step,
-    different mesh (2-D train mesh vs 1-D serve mesh)."""
+    different mesh (2-D train mesh vs 1-D serve mesh).
+
+    Compiles the PRODUCTION eval/serve configuration: the flash-blocked
+    fused step (corr_impl="flash" + fused_update — what
+    resolve_corr_impl("auto") picks on TPU), so the audited executables
+    are the volume-free ones that actually serve. The Pallas kernel is
+    forced into interpreter mode for the compile — this audit runs on
+    the CPU backend, where Mosaic cannot lower; the resolved in/out
+    shardings are unaffected (GSPMD partitions the jit boundary, and
+    the param tree is identical across corr impls by the
+    FusedCorrEncoder contract)."""
     import numpy as np
     import jax
 
@@ -159,13 +171,22 @@ def _audit_eval_step(mesh) -> Dict[str, Any]:
     from dexiraft_tpu.train.step import make_eval_step
 
     h, w = AUDIT_IMAGE
-    cfg = raft_v1(small=True)
-    step = make_eval_step(cfg, iters=AUDIT_ITERS, mesh=mesh)
+    cfg = raft_v1(small=True, corr_impl="flash", fused_update=True)
     state = _audit_state(cfg, TrainConfig())
     variables = {"params": state.params, "batch_stats": state.batch_stats}
     im = jax.ShapeDtypeStruct((AUDIT_BATCH, h, w, 3), np.float32)
     fi = jax.ShapeDtypeStruct((AUDIT_BATCH, h // 8, w // 8, 2), np.float32)
-    sections = _compiled_sections(step, (variables, im, im, None, None, fi))
+    prev = os.environ.get("DEXIRAFT_PALLAS_INTERPRET")
+    os.environ["DEXIRAFT_PALLAS_INTERPRET"] = "1"
+    try:
+        step = make_eval_step(cfg, iters=AUDIT_ITERS, mesh=mesh)
+        sections = _compiled_sections(step,
+                                      (variables, im, im, None, None, fi))
+    finally:
+        if prev is None:
+            os.environ.pop("DEXIRAFT_PALLAS_INTERPRET", None)
+        else:
+            os.environ["DEXIRAFT_PALLAS_INTERPRET"] = prev
     return {"mesh": _mesh_dict(mesh), **sections}
 
 
@@ -207,13 +228,22 @@ def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB
     # FULL-BATCH so every axis in the spec genuinely divides its dim —
     # a per-sample (B=1) total divided by the data axis would understate
     # the per-device footprint 4x (GSPMD cannot split a size-1 dim).
+    #
+    # The corr_volume group is GONE (ISSUE 12): the production eval/
+    # serve config is the flash-blocked kernel, which never materializes
+    # the all-pairs volume — only the fmaps live in HBM. The canary
+    # moved to corr_fmaps, the streamed tensor set of the on-demand
+    # path (fmap1 + the 4-level pooled fmap2 pyramid, 256-channel fp32):
+    # ~134 MB full-batch at 440x1024, still over the 64 MB tripwire if
+    # ever pinned replicated. (--corr_impl allpairs still exists; its
+    # volume keeps the canonical LAYOUT.corr_volume spec.)
+    fmap_bytes = b * hw8 * 256 * 4  # one (B, H/8, W/8, 256) fp32 fmap
+    pyramid_bytes = sum(b * (hw8 >> (2 * i)) * 256 * 4 for i in range(4))
     entries = [
         ("batch", LAYOUT.batch_for(mesh), b * h * w * 3 * 4 * 2),
         ("carry", LAYOUT.carry(), b * hw8 * 2 * 4),
-        # all-pairs volume: (H/8*W/8)^2 fp32 per sample — ~189 MB at
-        # 440x1024, ~1.5 GB for the batch; THE canary for silent
-        # replication
-        ("corr_volume", LAYOUT.corr_volume(mesh), b * hw8 * hw8 * 4),
+        ("corr_fmaps", LAYOUT.corr_fmaps(mesh),
+         fmap_bytes + pyramid_bytes),
         ("params", LAYOUT.params(), 5_300_000 * 4),
         ("opt_state", LAYOUT.opt_state(), 2 * 5_300_000 * 4),
     ]

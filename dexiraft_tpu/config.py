@@ -17,6 +17,48 @@ from typing import Optional, Tuple
 # model refuses to train with it (models/raft.py).
 CORR_DTYPES = ("fp32", "bf16", "int8")
 
+# correlation implementations: the materialized MXU volume, the XLA
+# on-demand path, the per-pixel Pallas kernel, and the flash-blocked
+# Pallas kernel (fmap2 streamed from HBM in row blocks — O(fmaps)
+# memory at any geometry; ops/pallas_corr.py). Jax-free for the same
+# CLI-parser reason as CORR_DTYPES.
+CORR_IMPLS = ("allpairs", "local", "pallas", "flash")
+
+
+def resolve_corr_impl(impl: str, platform: str) -> Tuple[str, bool]:
+    """Resolve an eval/serve CLI ``--corr_impl`` value to a concrete
+    (corr_impl, fused_update) pair.
+
+    "auto" is the production default: on TPU it resolves to the
+    flash-blocked fused step (corr_impl="flash", fused_update=True) —
+    the O(fmaps)-memory configuration that unlocks 1080p+ and
+    constant-memory video (docs/perf.md "Correlation memory &
+    precision"). Off-TPU it falls back to the materialized volume:
+    Pallas kernels only run off-chip in interpreter mode, which is
+    debug-speed, not serving-speed. Explicit values pass through with
+    fused_update=False (the CLI's --fused_update flag overrides).
+    """
+    if impl == "auto":
+        return ("flash", True) if platform == "tpu" else ("allpairs", False)
+    return impl, False
+
+
+def resolve_corr_impl_args(args, platform: str, label: str) -> Tuple[str, bool]:
+    """The eval/serve CLI glue around :func:`resolve_corr_impl`: merge
+    the --fused_update flag into the resolution, refuse fused on a
+    non-kernel impl with a one-line actionable error, and announce what
+    "auto" resolved to. ONE copy so the two CLIs cannot drift."""
+    impl, fused_auto = resolve_corr_impl(args.corr_impl, platform)
+    fused = args.fused_update or fused_auto
+    if fused and impl not in ("pallas", "flash"):
+        raise SystemExit(f"{label}: --fused_update requires --corr_impl "
+                         "flash or pallas (pass one explicitly — 'auto' "
+                         "resolves to allpairs off-TPU)")
+    if args.corr_impl == "auto":
+        print(f"[{label}] corr_impl auto -> {impl}"
+              f"{' + fused_update' if fused else ''}", flush=True)
+    return impl, fused
+
 
 @dataclasses.dataclass(frozen=True)
 class RAFTConfig:
@@ -39,7 +81,10 @@ class RAFTConfig:
     corr_radius: Optional[int] = None  # None -> 4 full / 3 small (core/raft.py:37-47)
     dropout: float = 0.0
     mixed_precision: bool = False  # bf16 compute in encoders/update; corr stays fp32
-    corr_impl: str = "allpairs"  # allpairs | local | pallas (on-demand paths)
+    # allpairs = materialized MXU volume; local/pallas/flash = on-demand
+    # paths (flash is the blocked HBM-streaming kernel — the production
+    # eval/serve default on TPU via resolve_corr_impl("auto", ...))
+    corr_impl: str = "allpairs"
     # STORAGE precision of the correlation pyramid (allpairs: the
     # materialized volume levels; local/pallas: the fmap2 pyramid the
     # lookup streams) — "fp32" | "bf16" | "int8" (per-level scale,
@@ -50,11 +95,12 @@ class RAFTConfig:
     corr_dtype: str = "fp32"
     # fuse each refinement iteration's 4-level window lookup WITH the
     # motion encoder's 1x1 corr conv into ONE Pallas kernel
-    # (ops/pallas_corr.pallas_fused_step): the (2r+1)^2-per-level corr
-    # features never round-trip HBM — only the conv's F-channel output
-    # does. Requires corr_impl="pallas" (the VMEM-kernel formulation);
-    # parameter tree is IDENTICAL to the unfused path, so checkpoints
-    # interchange (models/update.py FusedCorrEncoder)
+    # (ops/pallas_corr.pallas_fused_step / flash_fused_step): the
+    # (2r+1)^2-per-level corr features never round-trip HBM — only the
+    # conv's F-channel output does. Requires corr_impl="pallas" or
+    # "flash" (the VMEM-kernel formulations); parameter tree is
+    # IDENTICAL to the unfused path, so checkpoints interchange
+    # (models/update.py FusedCorrEncoder)
     fused_update: bool = False
     # rows per chunk for the local path's gather (bounds the transient
     # patch buffer to rows*W*(2r+2)^2*C floats; None = whole frame at once)
@@ -82,6 +128,27 @@ class RAFTConfig:
     # lookup's hat-matrix build with the current GRU) at the cost of
     # code-size/compile time. Numerically identical; eval-latency knob
     scan_unroll: int = 1
+
+    def __post_init__(self):
+        # config-time refusals (ISSUE 12 satellite): an unknown
+        # corr_impl / corr_dtype / fused_update combination fails HERE,
+        # at construction, not as a store_corr ValueError deep inside
+        # build_local_corr mid-trace. Runtime-dependent checks (int8
+        # under train=True) stay in models/raft.py.
+        if self.corr_impl not in CORR_IMPLS:
+            raise ValueError(
+                f"unknown corr_impl {self.corr_impl!r}; expected one of "
+                f"{CORR_IMPLS}")
+        if self.corr_dtype not in CORR_DTYPES:
+            raise ValueError(
+                f"unknown corr_dtype {self.corr_dtype!r}; expected one "
+                f"of {CORR_DTYPES}")
+        if self.fused_update and self.corr_impl not in ("pallas", "flash"):
+            raise ValueError(
+                "fused_update=True requires corr_impl='flash' (the "
+                "blocked HBM-streaming kernel — the production default) "
+                "or 'pallas' (the per-pixel VMEM formulation); the "
+                "allpairs volume cannot be tiled per pixel block")
 
     @property
     def radius(self) -> int:

@@ -32,10 +32,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--corr_impl", default="allpairs",
-                   choices=["allpairs", "local", "pallas"],
-                   help="'local'/'pallas' = the memory-efficient on-demand "
-                        "path (the reference's --alternate_corr)")
+    p.add_argument("--corr_impl", default="auto",
+                   choices=["auto", "allpairs", "local", "pallas", "flash"],
+                   help="'local'/'pallas'/'flash' = the memory-efficient "
+                        "on-demand paths (the reference's "
+                        "--alternate_corr); 'auto' (default) = the "
+                        "production config: flash-blocked fused step on "
+                        "TPU, allpairs off-chip (Pallas kernels only run "
+                        "off-TPU in debug-speed interpreter mode)")
     p.add_argument("--corr_dtype", default="fp32",
                    choices=["fp32", "bf16", "int8"],
                    help="storage precision of the correlation pyramid "
@@ -92,13 +96,15 @@ def load_variables(args):
         ckpt.require_checkpoints(args.model)
     except FileNotFoundError as e:
         raise SystemExit(f"eval: {e}")
-    if args.fused_update and args.corr_impl != "pallas":
-        raise SystemExit("eval: --fused_update requires --corr_impl pallas")
+    from dexiraft_tpu.config import resolve_corr_impl_args
+
+    impl, fused = resolve_corr_impl_args(args, jax.devices()[0].platform,
+                                         "eval")
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
-                                 corr_impl=args.corr_impl,
+                                 corr_impl=impl,
                                  corr_dtype=args.corr_dtype,
-                                 fused_update=args.fused_update,
+                                 fused_update=fused,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())

@@ -178,18 +178,10 @@ class RAFT(nn.Module):
         or (flow_low, flow_up) in test_mode (core/raft.py:194-197).
         """
         cfg = self.cfg
-        if cfg.corr_impl not in ("allpairs", "local", "pallas"):
-            raise ValueError(f"unknown corr_impl {cfg.corr_impl!r}")
-        from dexiraft_tpu.config import CORR_DTYPES
-
-        if cfg.corr_dtype not in CORR_DTYPES:
-            raise ValueError(f"unknown corr_dtype {cfg.corr_dtype!r}; "
-                             f"expected one of {CORR_DTYPES}")
-        if cfg.fused_update and cfg.corr_impl != "pallas":
-            raise ValueError(
-                "fused_update=True requires corr_impl='pallas' (the fused "
-                "step kernel is the VMEM lookup formulation; the allpairs "
-                "volume cannot be tiled per pixel block)")
+        # corr_impl/corr_dtype/fused_update combinations are refused at
+        # CONFIG time (RAFTConfig.__post_init__) — by the time a config
+        # reaches apply() they are known-valid. Only the runtime-
+        # dependent refusals live here.
         if train and cfg.corr_dtype == "int8":
             raise ValueError(
                 "corr_dtype='int8' is an inference format: the round() in "
@@ -253,8 +245,9 @@ class RAFT(nn.Module):
                                           dtype=cfg.corr_dtype)
             return build_local_corr(f1, f2, cfg.corr_levels, cfg.radius,
                                     row_chunk=cfg.corr_row_chunk,
-                                    use_pallas=cfg.corr_impl == "pallas",
-                                    dtype=cfg.corr_dtype)
+                                    dtype=cfg.corr_dtype,
+                                    kernel=("xla" if cfg.corr_impl == "local"
+                                            else cfg.corr_impl))
 
         fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
                             train=train, bn_train=bn_train)

@@ -111,7 +111,10 @@ class FusedCorrEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, pyr, coords):
-        from dexiraft_tpu.ops.pallas_corr import pallas_fused_step
+        from dexiraft_tpu.ops.pallas_corr import (
+            flash_fused_step,
+            pallas_fused_step,
+        )
 
         num_levels = len(pyr.fmap2_pyramid)
         win = 2 * pyr.radius + 1
@@ -126,9 +129,14 @@ class FusedCorrEncoder(nn.Module):
             w = jnp.concatenate(
                 [w[lvl * ww:(lvl + 1) * ww] * pyr.scales[lvl]
                  for lvl in range(num_levels)], axis=0)
-        out = pallas_fused_step(pyr.fmap1, pyr.fmap2_pyramid, coords,
-                                w, bias.astype(jnp.float32), pyr.radius,
-                                None, pyr.row_chunk)
+        # flash = the blocked HBM-streaming kernel (ONE call at any
+        # geometry); pallas = the per-pixel VMEM formulation with its
+        # fp32 budget split. Same VJP contract, same param tree.
+        step = (flash_fused_step if pyr.kernel == "flash"
+                else pallas_fused_step)
+        out = step(pyr.fmap1, pyr.fmap2_pyramid, coords,
+                   w, bias.astype(jnp.float32), pyr.radius,
+                   None, pyr.row_chunk)
         return out.astype(self.dtype)
 
 

@@ -110,7 +110,10 @@ class LocalCorr:
     wd: int = flax.struct.field(pytree_node=False)
     radius: int = flax.struct.field(pytree_node=False)
     row_chunk: Optional[int] = flax.struct.field(pytree_node=False, default=None)
-    use_pallas: bool = flax.struct.field(pytree_node=False, default=False)
+    # lookup implementation: "xla" (local_corr_level matmuls), "pallas"
+    # (per-pixel slice kernel), "flash" (blocked HBM-streaming kernel —
+    # ops/pallas_corr.py flash_local_corr_level / flash_fused_step)
+    kernel: str = flax.struct.field(pytree_node=False, default="xla")
     # per-level fp32 scalar dequantization scales for int8-stored fmap2
     # levels (ops/quant.py); None for fp32/bf16. Correlation is linear in
     # fmap2, so corr(f1, s*q) = s * corr(f1, q): the scale multiplies the
@@ -126,16 +129,20 @@ class LocalCorr:
         out: List[jax.Array] = []
         for i, f2 in enumerate(self.fmap2_pyramid):
             coords_i = coords / (2.0 ** i)
-            if self.use_pallas:
-                from dexiraft_tpu.ops.pallas_corr import pallas_local_corr_level
+            if self.kernel in ("pallas", "flash"):
+                from dexiraft_tpu.ops.pallas_corr import (
+                    flash_local_corr_level,
+                    pallas_local_corr_level,
+                )
 
                 # interpret=None defers to the kernel module's
-                # DEXIRAFT_PALLAS_INTERPRET env knob, which makes this
-                # whole-model path exercisable off-chip
-                # (tests/test_local_corr.py)
-                corr = pallas_local_corr_level(
-                    self.fmap1, f2, coords_i, self.radius,
-                    None, self.row_chunk)
+                # DEXIRAFT_PALLAS_INTERPRET env knob, which makes these
+                # whole-model paths exercisable off-chip
+                # (tests/test_local_corr.py, tests/test_zzzflashcorr.py)
+                level = (flash_local_corr_level if self.kernel == "flash"
+                         else pallas_local_corr_level)
+                corr = level(self.fmap1, f2, coords_i, self.radius,
+                             None, self.row_chunk)
             else:
                 corr = local_corr_level(
                     self.fmap1, f2, coords_i, self.radius, self.row_chunk)
@@ -154,6 +161,7 @@ def build_local_corr(
     row_chunk: Optional[int] = None,
     use_pallas: bool = False,
     dtype: str = "fp32",
+    kernel: Optional[str] = None,
 ) -> LocalCorr:
     """Build the pooled-fmap2 pyramid (no volume materialization).
 
@@ -162,7 +170,16 @@ def build_local_corr(
     per pixel block, not once per lattice point). Pooling runs fp32; each
     level is then stored bf16/int8 with a per-level scale (ops/quant.py)
     and the lookup dequantizes in-register.
+
+    ``kernel`` picks the lookup implementation ("xla" | "pallas" |
+    "flash"); ``use_pallas`` is the legacy boolean spelling of
+    kernel="pallas" and is ignored when ``kernel`` is given.
     """
+    if kernel is None:
+        kernel = "pallas" if use_pallas else "xla"
+    if kernel not in ("xla", "pallas", "flash"):
+        raise ValueError(f"unknown local-corr kernel {kernel!r}; "
+                         "expected 'xla', 'pallas', or 'flash'")
     b, h, w, _ = fmap1.shape
     f1 = fmap1.astype(jnp.float32)
     pooled = [fmap2.astype(jnp.float32)]
@@ -172,5 +189,5 @@ def build_local_corr(
     return LocalCorr(
         fmap1=f1, fmap2_pyramid=tuple(s[0] for s in stored),
         batch=b, ht=h, wd=w,
-        radius=radius, row_chunk=row_chunk, use_pallas=use_pallas,
+        radius=radius, row_chunk=row_chunk, kernel=kernel,
         scales=(tuple(s[1] for s in stored) if dtype == "int8" else None))

@@ -24,11 +24,25 @@ Gradients: forward-only kernel wrapped in jax.custom_vjp; the VJP
 recomputes through the XLA gather formulation (local_corr_level), giving
 fmap gradients and zero coords gradient — the CUDA backward's semantics
 (correlation_kernel.cu:307) without a second hand-written kernel.
+
+Three kernel generations live here, newest last:
+  * the per-pixel slice kernels (corr_impl="pallas"): gather-shaped
+    per-query dynamic slices, whole padded fmap2 levels staged in VMEM;
+  * the fused per-pixel step (pallas_fused_step): the same lattice
+    machinery plus the motion encoder's 1x1 corr conv in-kernel, with a
+    VMEM-budget split path at large fp32 geometries;
+  * the flash-blocked kernels (corr_impl="flash" —
+    flash_local_corr_level / flash_fused_step): fmap2 stays in HBM and
+    is row-block-streamed per fmap1 pixel block, the partial correlation
+    is a block x blockᵀ MXU matmul windowed in-register by the hat
+    matrices, and there is no budget split at any geometry. See the
+    "Flash-blocked kernel" section below.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +58,6 @@ _PIXEL_BLOCK = 256
 
 
 def _pixel_block() -> int:
-    import os
-
     # the batched variant stages (P, k, k, C) fp32 patches in VMEM
     # (~100 KiB per pixel at C=256, r=4), so its default block must be
     # much smaller than the loop kernel's
@@ -61,8 +73,6 @@ def _interpret_default() -> bool:
     # (trace-time switch) — lets the whole-model corr_impl="pallas" path
     # run off-chip (tests/test_local_corr.py). Never set it on a TPU
     # host: the interpreter is orders of magnitude slower.
-    import os
-
     return os.environ.get("DEXIRAFT_PALLAS_INTERPRET", "0") == "1"
 
 
@@ -75,8 +85,6 @@ def _variant() -> str:
     # Costs P*k*k*C*4 B of extra VMEM, so "batched" wants a SMALLER
     # pixel block (default 32 vs 256). Trace-time switch; the on-chip
     # A/B lives in scripts/tpu_smoke.py.
-    import os
-
     v = os.environ.get("DEXIRAFT_PALLAS_VARIANT", "loop")
     return v if v in ("loop", "batched") else "loop"
 
@@ -376,15 +384,38 @@ def _index_prep(coords: jax.Array, h2: int, w2: int, radius: int):
 # path splits into per-level fused calls (each holds ONE level, the
 # footprint the per-level kernel already proves fits); bf16 (~9 MB) and
 # int8 (~4.5 MB) stay single-call, which is the configuration the fused
-# kernel exists for. Env-overridable for on-chip tuning.
-_FUSED_LEVELS_VMEM_BYTES = 12 * 1024 * 1024
+# kernel exists for. The env override is parsed ONCE at module load
+# (tests override the module constant, not the environment).
+_FUSED_LEVELS_VMEM_DEFAULT = 12 * 1024 * 1024
+
+
+def _parse_positive_int_env(name: str, default: int) -> int:
+    """Parse an integer-bytes env override once, at module load, with an
+    actionable refusal instead of a bare ValueError from int()."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer; set a byte count "
+            f"(e.g. {default} = {default // 2**20} MiB) or unset it"
+        ) from None
+    if value <= 0:
+        raise ValueError(
+            f"{name}={raw!r} must be a positive byte count; the VMEM "
+            f"budget bounds the fmap2 levels one fused call stages "
+            f"(default {default})")
+    return value
+
+
+_FUSED_LEVELS_VMEM_BYTES = _parse_positive_int_env(
+    "DEXIRAFT_FUSED_LEVELS_VMEM_BYTES", _FUSED_LEVELS_VMEM_DEFAULT)
 
 
 def _fused_levels_budget() -> int:
-    import os
-
-    return int(os.environ.get("DEXIRAFT_FUSED_LEVELS_VMEM_BYTES",
-                              _FUSED_LEVELS_VMEM_BYTES))
+    return _FUSED_LEVELS_VMEM_BYTES
 
 
 def _fused_forward(fmap1: jax.Array, fmap2_levels: tuple, coords: jax.Array,
@@ -422,8 +453,6 @@ def _fused_forward(fmap1: jax.Array, fmap2_levels: tuple, coords: jax.Array,
                     interpret)
                 out = o if out is None else out + o
             return out + bias.astype(jnp.float32)
-
-    import os
 
     # the fused kernel has the loop kernel's VMEM shape (one (P, k*k)
     # lattice scratch), so it shares the loop default — not the batched
@@ -550,3 +579,309 @@ def _fused_bwd(radius, interpret, row_chunk, res, g):
 
 
 pallas_fused_step.defvjp(_fused_fwd, _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Flash-blocked kernel: the materialized-volume killer (ISSUE 12)
+# ---------------------------------------------------------------------------
+#
+# The per-pixel kernels above are gather-shaped (one (k, k, C) dynamic
+# slice + VPU reduce per query) and must stage whole padded fmap2 levels
+# in VMEM, which is why _fused_forward splits into per-level calls when
+# the fp32 pyramid blows the budget. The flash-blocked kernel is the
+# flash-attention translation of alt_cuda_corr instead: fmap2 levels
+# STAY IN HBM (memory_space=ANY); per fmap1 pixel block the kernel DMAs
+# VMEM-sized row blocks of each level, computes the partial all-pairs
+# correlation as ONE block x blockᵀ MXU matmul (the exact formulation
+# ops/local_corr.py proves correct in XLA), windows it in-register with
+# the separable triangular hat matrices of ops.corr._axis_interp_matrix
+# (bilinear blend + out-of-frame zeroing in one expression — no corner
+# blending, no coordinate clipping), and accumulates. Row blocks whose
+# rows cannot intersect any query window in the block (hat support is
+# empty outside [ty - r - 1, ty + r + 1]) are skipped before the DMA,
+# so HBM traffic tracks the windows actually needed, not H2 x W2.
+#
+# Consequences: VMEM use is O(pixel_block) at ANY geometry (no budget
+# split path), HBM holds only the fmaps (never a volume, never padded
+# per-level copies — levels are padded only to a row-block multiple),
+# and there is ONE kernel per refinement iteration. The fused variant
+# additionally contracts each level's window against the motion
+# encoder's weight slice in-kernel (same contract as _fused_kernel: the
+# kernel applies 1/sqrt(C) itself, the caller folds only int8 scales
+# into the weights); the unfused variant writes the (P, L*win^2) window
+# features — the flash lookup for corr_impl="flash" without
+# fused_update.
+
+# queries per flash grid step / fmap2 rows per DMA block. Trace-time
+# env knobs like DEXIRAFT_PALLAS_PIXEL_BLOCK; the defaults bound the
+# resident set to ~4 MB at C=256 (f1 block 256 KB + one (8, W2, C)
+# row block + the (P, rows*W2) dots transient).
+_FLASH_PIXEL_BLOCK = 256
+_FLASH_ROWS = 8
+
+
+def _flash_pixel_block() -> int:
+    return max(1, int(os.environ.get("DEXIRAFT_FLASH_PIXEL_BLOCK",
+                                     _FLASH_PIXEL_BLOCK)))
+
+
+def _flash_rows() -> int:
+    return max(1, int(os.environ.get("DEXIRAFT_FLASH_ROWS", _FLASH_ROWS)))
+
+
+def _hat(taps_center, length, offset, radius, p_block):
+    """(P,) centers -> (P, 2r+1, length) triangular hat weights for axis
+    positions offset..offset+length-1 — the in-kernel twin of
+    ops.corr._axis_interp_matrix(center, radius, length, offset):
+    A[p, j, q] = relu(1 - |(offset + q) - (center_p + j - r)|). Out-of-
+    range taps have empty support, reproducing bilinear_sampler's zero
+    padding; zero-padded rows/cols get weights but multiply zeros."""
+    win = 2 * radius + 1
+    pos = offset + jax.lax.broadcasted_iota(
+        jnp.float32, (p_block, win, length), 2)
+    tap = (taps_center[:, None, None]
+           + jax.lax.broadcasted_iota(jnp.float32, (p_block, win, length), 1)
+           - radius)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(pos - tap))
+
+
+def _flash_kernel(*refs, radius: int, level_ids: tuple, level_shapes: tuple,
+                  num_levels_total: int, rows: int, fused: bool):
+    """refs: f1, coords, [w, b], f2 level refs (ANY/HBM), out, then
+    scratch: f2 row-block buffer, window accumulator, [out accumulator],
+    DMA semaphore.
+
+    ``level_ids`` are the ORIGINAL pyramid indices of the staged levels
+    (degenerate 0-row tail levels are filtered out on the XLA side —
+    their windows are identically zero); ``num_levels_total`` sizes the
+    unfused output / weight slicing in original-pyramid channels."""
+    n_lvls = len(level_ids)
+    if fused:
+        f1_ref, coords_ref, w_ref, b_ref = refs[:4]
+        lvl_refs = refs[4:4 + n_lvls]
+        out_ref = refs[4 + n_lvls]
+        f2blk_ref, win_ref, acc_ref, sem = refs[5 + n_lvls:]
+    else:
+        f1_ref, coords_ref = refs[:2]
+        lvl_refs = refs[2:2 + n_lvls]
+        out_ref = refs[2 + n_lvls]
+        f2blk_ref, win_ref, sem = refs[3 + n_lvls:]
+
+    r = radius
+    win = 2 * r + 1
+    p_block = f1_ref.shape[1]
+    c = f1_ref.shape[2]
+    bi = pl.program_id(0)
+
+    # fold the 1/sqrt(C) normalization into the query block once — every
+    # dots matmul below then carries it (linear), same division of labor
+    # as the per-pixel kernels (the caller never folds it into weights)
+    f1 = f1_ref[0].astype(jnp.float32) * (1.0 / (c ** 0.5))
+    if fused:
+        acc_ref[...] = jnp.broadcast_to(b_ref[0].astype(jnp.float32),
+                                        (p_block, b_ref.shape[1]))
+    elif n_lvls < num_levels_total:
+        # filtered degenerate levels own output channels nobody writes —
+        # zero the whole block once so they read as the zero windows
+        # they are
+        out_ref[0] = jnp.zeros(
+            (p_block, num_levels_total * win * win), jnp.float32)
+
+    for f2_ref, lvl, (h2, w2) in zip(lvl_refs, level_ids, level_shapes):
+        n_blocks = f2_ref.shape[1] // rows
+        inv = 1.0 / (2.0 ** lvl)
+        tx = coords_ref[0, :, 0].astype(jnp.float32) * inv  # (P,)
+        ty = coords_ref[0, :, 1].astype(jnp.float32) * inv
+        # x hats cover the whole level width (a row of queries spans it);
+        # y hats are built per row block inside the loop
+        ax = _hat(tx, w2, 0, r, p_block)  # (P, win, w2)
+        # hat support of tap t is (t-1, t+1); taps span [ty-r, ty+r] —
+        # a row block outside [min ty - r - 1, max ty + r + 1] cannot
+        # contribute, so its DMA and matmuls are skipped entirely
+        t_lo = jnp.min(ty) - (r + 1)
+        t_hi = jnp.max(ty) + (r + 1)
+        win_ref[...] = jnp.zeros_like(win_ref)
+
+        def body(blk_i, _, f2_ref=f2_ref, ax=ax, ty=ty,
+                 t_lo=t_lo, t_hi=t_hi, w2=w2):
+            row0 = blk_i * rows
+
+            @pl.when((row0 <= t_hi) & (row0 + rows - 1 >= t_lo))
+            def _():
+                dma = pltpu.make_async_copy(
+                    f2_ref.at[bi, pl.ds(row0, rows)],
+                    f2blk_ref.at[:, :w2, :], sem)
+                dma.start()
+                dma.wait()
+                blk = (f2blk_ref[:, :w2, :]
+                       .reshape(rows * w2, c).astype(jnp.float32))
+                # partial all-pairs block: (P, C) x (rows*w2, C)ᵀ on the
+                # MXU — the local_corr formulation, never materialized
+                # beyond this row block
+                dots = jax.lax.dot_general(
+                    f1, blk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dots = dots.reshape(p_block, rows, w2)
+                ay = _hat(ty, rows, row0, r, p_block)  # (P, win, rows)
+                rows_c = jax.lax.dot_general(  # (P, win_y, w2)
+                    ay, dots, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                wp = jax.lax.dot_general(  # (P, win_x, win_y) — x slow,
+                    ax, rows_c, (((2,), (2,)), ((0,), (0,))),  # ops.corr
+                    preferred_element_type=jnp.float32)  # channel order
+                win_ref[...] += wp.reshape(p_block, win * win)
+            return 0
+
+        jax.lax.fori_loop(0, n_blocks, body, 0)
+
+        if fused:
+            w_lvl = w_ref[pl.ds(lvl * win * win, win * win), :]
+            acc_ref[...] += jnp.dot(win_ref[...], w_lvl.astype(jnp.float32),
+                                    preferred_element_type=jnp.float32)
+        else:
+            out_ref[0, :, lvl * win * win:(lvl + 1) * win * win] = win_ref[...]
+    if fused:
+        out_ref[0] = acc_ref[...]
+
+
+def _flash_forward(fmap1: jax.Array, fmap2_levels: tuple, coords: jax.Array,
+                   weight, bias, radius: int, interpret=None) -> jax.Array:
+    """Shared XLA-side prep for the fused (weight/bias given) and lookup
+    (weight=bias=None) flash kernels. fmap2 levels are padded only to a
+    row-block multiple (zero rows read as out-of-frame) and enter the
+    kernel in HBM; everything else is pixel-blocked into VMEM."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, w, c = fmap1.shape
+    r = radius
+    win = 2 * r + 1
+    num_levels = len(fmap2_levels)
+    fused = weight is not None
+    rows = _flash_rows()
+    pixel_block = _flash_pixel_block()
+
+    # degenerate 0-row/0-col tail levels (a 1x1 level pools to nothing)
+    # never enter the kernel: their windows are identically zero, and a
+    # zero-size operand cannot flow through pallas_call
+    level_ids = tuple(i for i, f2 in enumerate(fmap2_levels)
+                      if f2.shape[1] > 0 and f2.shape[2] > 0)
+    if not level_ids:
+        # every staged level is degenerate (single-level call on a
+        # pooled-away tail): the window features are identically zero,
+        # so the fused output is just the broadcast bias
+        if fused:
+            return jnp.broadcast_to(bias.astype(jnp.float32),
+                                    (b, h, w, weight.shape[1]))
+        return jnp.zeros((b, h, w, num_levels * win * win), jnp.float32)
+    kept = [fmap2_levels[i] for i in level_ids]
+    level_shapes = tuple(f2.shape[1:3] for f2 in kept)
+
+    # pad each level's rows to the DMA block size in the STORAGE dtype
+    # (fp32/bf16/int8 — the quantized bytes are what stream HBM->VMEM)
+    f2p = [jnp.pad(f2, ((0, 0), (0, (-f2.shape[1]) % rows),
+                        (0, 0), (0, 0)))
+           for f2 in kept]
+    w2_max = max(s[1] for s in level_shapes)
+
+    n = h * w
+    n_pad = (-n) % pixel_block
+    np_tot = n + n_pad
+    flat = lambda a: jnp.pad(  # noqa: E731
+        a.reshape(b, n, a.shape[3]), ((0, 0), (0, n_pad), (0, 0)))
+    f1_flat = flat(fmap1.astype(jnp.float32))
+    # padded tail queries carry coords 0 — they force row block 0 of each
+    # level to be fetched, compute a real window, and are sliced away
+    co_flat = flat(coords.astype(jnp.float32))
+
+    grid = (b, np_tot // pixel_block)
+    f1_spec = pl.BlockSpec((1, pixel_block, c), lambda bi, ti: (bi, ti, 0),
+                           memory_space=pltpu.VMEM)
+    co_spec = pl.BlockSpec((1, pixel_block, 2), lambda bi, ti: (bi, ti, 0),
+                           memory_space=pltpu.VMEM)
+    inputs = [f1_flat, co_flat]
+    in_specs = [f1_spec, co_spec]
+    if fused:
+        feat = weight.shape[1]
+        inputs += [weight.astype(jnp.float32),
+                   bias.reshape(1, feat).astype(jnp.float32)]
+        in_specs += [
+            pl.BlockSpec((num_levels * win * win, feat),
+                         lambda bi, ti: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, feat), lambda bi, ti: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        out_ch = feat
+    else:
+        out_ch = num_levels * win * win
+    # the fmap2 levels: full arrays, HBM-resident — the kernel DMAs row
+    # blocks on demand
+    inputs += f2p
+    in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * len(f2p)
+
+    scratch = [pltpu.VMEM((rows, w2_max, c), f2p[0].dtype),
+               pltpu.VMEM((pixel_block, win * win), jnp.float32)]
+    if fused:
+        scratch.append(pltpu.VMEM((pixel_block, out_ch), jnp.float32))
+    scratch.append(pltpu.SemaphoreType.DMA)
+
+    kernel = functools.partial(_flash_kernel, radius=r,
+                               level_ids=level_ids,
+                               level_shapes=level_shapes,
+                               num_levels_total=num_levels,
+                               rows=rows, fused=fused)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, pixel_block, out_ch),
+                               lambda bi, ti: (bi, ti, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, np_tot, out_ch), jnp.float32),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*inputs)
+    return out[:, :n].reshape(b, h, w, out_ch)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_local_corr_level(fmap1, fmap2, coords, radius: int,
+                           interpret=None, row_chunk=8):
+    """Flash-blocked single-level lookup: same signature/semantics as
+    pallas_local_corr_level (coords in LEVEL pixels, zero coords grad,
+    VJP recomputes through local_corr_level) but fmap2 stays in HBM and
+    the window is built from blocked MXU matmuls, not per-pixel slices."""
+    return _flash_forward(fmap1, (fmap2,), coords, None, None, radius,
+                          interpret)
+
+
+def _flash_level_fwd(fmap1, fmap2, coords, radius, interpret, row_chunk):
+    return (_flash_forward(fmap1, (fmap2,), coords, None, None, radius,
+                           interpret),
+            (fmap1, fmap2, coords))
+
+
+flash_local_corr_level.defvjp(_flash_level_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_fused_step(fmap1, fmap2_levels, coords, weight, bias,
+                     radius: int, interpret=None, row_chunk=8):
+    """Flash-blocked fused lookup+update-entry — pallas_fused_step's
+    signature and custom-VJP contract (recompute through fused_reference,
+    zero coords grad, int8 levels -> float0), ONE kernel per refinement
+    iteration at ANY geometry: only the fmaps live in HBM, the window
+    features and per-level intermediates never leave VMEM, and there is
+    no VMEM-budget split path (levels are row-block-streamed, not staged
+    whole)."""
+    return _flash_forward(fmap1, tuple(fmap2_levels), coords, weight, bias,
+                          radius, interpret)
+
+
+def _flash_fused_fwd(fmap1, fmap2_levels, coords, weight, bias, radius,
+                     interpret, row_chunk):
+    out = _flash_forward(fmap1, tuple(fmap2_levels), coords, weight, bias,
+                         radius, interpret)
+    return out, (fmap1, tuple(fmap2_levels), coords, weight, bias)
+
+
+flash_fused_step.defvjp(_flash_fused_fwd, _fused_bwd)

@@ -122,10 +122,22 @@ class SpecLayout:
                 else self.batch())
 
     def corr_volume(self, mesh: Mesh) -> PartitionSpec:
-        """The ~200 MB all-pairs correlation volume (B, H, W, H*W) — the
-        audit's canary array: batch over 'data', query rows over 'seq'
-        when the mesh has the axis. Fully replicating this one is the
-        exact failure the size tripwire exists for."""
+        """The ~200 MB all-pairs correlation volume (B, H, W, H*W):
+        batch over 'data', query rows over 'seq' when the mesh has the
+        axis. Since the flash-blocked kernel became the production
+        eval/serve config (ISSUE 12) the volume only materializes behind
+        --corr_impl allpairs; its canonical spec is kept for that path,
+        but the audit's declared canary moved to corr_fmaps."""
+        return self.batch_for(mesh)
+
+    def corr_fmaps(self, mesh: Mesh) -> PartitionSpec:
+        """The on-demand correlation paths' streamed tensor set — fmap1
+        plus the pooled fmap2 pyramid, (B, H/8, W/8, C)-shaped — the
+        audit's canary group now that eval/serve default to the
+        volume-free flash kernel: batch over 'data', rows over 'seq'
+        like every spatial activation. O(fmaps) is the whole point;
+        replicating them at pod batch sizes would still be a layout
+        bug the size tripwire must catch."""
         return self.batch_for(mesh)
 
     # ---- mesh shape queries -------------------------------------------

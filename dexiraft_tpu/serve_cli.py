@@ -43,8 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--variant", default="v1", choices=sorted(VARIANTS))
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--corr_impl", default="allpairs",
-                   choices=["allpairs", "local", "pallas"])
+    p.add_argument("--corr_impl", default="auto",
+                   choices=["auto", "allpairs", "local", "pallas", "flash"],
+                   help="'auto' (default) = the production config: "
+                        "flash-blocked fused step on TPU (O(fmaps) "
+                        "correlation memory at any geometry), allpairs "
+                        "off-chip")
     p.add_argument("--corr_dtype", default="fp32",
                    choices=["fp32", "bf16", "int8"],
                    help="correlation-pyramid storage precision (bf16 "
@@ -52,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused_update", action="store_true",
                    help="one fused Pallas lookup+update kernel per "
                         "refinement iteration (requires --corr_impl "
-                        "pallas)")
+                        "flash or pallas)")
     p.add_argument("--scan_unroll", type=int, default=1)
     p.add_argument("--dexined_upconv", default="subpixel",
                    choices=["transpose", "subpixel"])
@@ -170,13 +174,15 @@ def _load(args):
     from dexiraft_tpu.train import checkpoint as ckpt
     from dexiraft_tpu.train.state import create_state
 
-    if args.fused_update and args.corr_impl != "pallas":
-        raise SystemExit("serve: --fused_update requires --corr_impl pallas")
+    from dexiraft_tpu.config import resolve_corr_impl_args
+
+    impl, fused = resolve_corr_impl_args(args, jax.devices()[0].platform,
+                                         "serve")
     cfg = VARIANTS[args.variant](small=args.small,
                                  mixed_precision=args.mixed_precision,
-                                 corr_impl=args.corr_impl,
+                                 corr_impl=impl,
                                  corr_dtype=args.corr_dtype,
-                                 fused_update=args.fused_update,
+                                 fused_update=fused,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
     if args.synthetic_init:

@@ -43,7 +43,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--corr_impl", default="allpairs",
-                   choices=["allpairs", "local", "pallas"])
+                   choices=["allpairs", "local", "pallas", "flash"])
     p.add_argument("--corr_dtype", default="fp32", choices=["fp32", "bf16"],
                    help="storage precision of the correlation pyramid "
                         "(halves HBM traffic of the refinement loop at "
@@ -51,8 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused_update", action="store_true",
                    help="fuse each iteration's 4-level lookup with the "
                         "motion encoder's corr conv into one Pallas "
-                        "kernel (requires --corr_impl pallas; identical "
-                        "param tree, checkpoints interchange)")
+                        "kernel (requires --corr_impl flash or pallas; "
+                        "identical param tree, checkpoints interchange)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize refinement iterations in backward "
                         "(HBM savings at ~1 extra forward of FLOPs)")
@@ -211,6 +211,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
+    if args.fused_update and args.corr_impl not in ("pallas", "flash"):
+        raise SystemExit("train: --fused_update requires --corr_impl "
+                         "flash (the blocked HBM-streaming kernel) or "
+                         "pallas (the per-pixel VMEM formulation)")
     cfg = VARIANTS[args.variant](
         small=args.small,
         mixed_precision=args.mixed_precision,
@@ -222,10 +226,6 @@ def resolve_configs(args) -> "tuple[RAFTConfig, TrainConfig]":
         remat_lookup=args.remat_lookup,
         dexined_upconv=args.dexined_upconv,
     )
-    if cfg.fused_update and cfg.corr_impl != "pallas":
-        raise SystemExit("train: --fused_update requires --corr_impl pallas "
-                         "(the fused step kernel is the VMEM lookup "
-                         "formulation)")
 
     if args.preset != "none":
         stages = (cfglib.STANDARD_STAGES if args.preset == "standard"

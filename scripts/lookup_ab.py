@@ -19,6 +19,14 @@ logs/ carries records in them):
   --variant 3   bf16 inputs for the on-demand (local) corr path
     fp32/bf16/bf16_all timing + max|delta| accuracy bound per variant
 
+  --variant 4   the three lookup FORMULATIONS head-to-head (ISSUE 12):
+    allpairs   materialized volume + one-hot matmul lookup (corr_lookup)
+    pallas     per-pixel slice kernel (pallas_local_corr_level)
+    flash      flash-blocked kernel — fmap2 row-block-streamed from HBM,
+               partial-volume MXU matmuls, no materialized volume
+    On the CPU fallback the Pallas legs run in interpreter mode at a
+    reduced geometry/iteration count (printed) — code-path proof only.
+
 Each timed run is 32 chained 2-stream lookups inside one scan
 (carry-dependent so iterations cannot be collapsed), one scalar out =
 one tunnel round-trip.
@@ -463,15 +471,83 @@ def main_v3():
               f"(raw {raw * 1e3:.1f}), {dt / ITERS * 1e3:6.2f} ms/iter")
 
 
+# ---------------------------------------------------------------------------
+# variant 4: the three formulations head-to-head (ISSUE 12)
+# ---------------------------------------------------------------------------
+# allpairs amortizes one volume build over the loop but streams the
+# O(N^2) volume from HBM every lookup; per-pixel pallas avoids the
+# volume but is gather-shaped; flash-blocked recomputes the needed
+# partial-volume blocks as MXU matmuls with only the fmaps in HBM.
+
+def main_v4():
+    import os
+
+    from dexiraft_tpu.ops.local_corr import build_local_corr
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    h8, w8, iters = (H8, W8, ITERS) if on_tpu else (16, 32, 4)
+    if not on_tpu:
+        # interpreter-mode kernels at the full geometry are debug-speed
+        # (the per-pixel kernel loops 7040 slices per level per iter) —
+        # the CPU leg proves the code paths, not the ordering
+        os.environ.setdefault("DEXIRAFT_PALLAS_INTERPRET", "1")
+        print(f"cpu fallback: reduced geometry {h8}x{w8}, {iters} iters "
+              "— code-path proof only, interpret-mode kernels",
+              file=sys.stderr)
+    _print_rtt()
+
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (1, h8, w8, C), jnp.float32)
+    f2 = jax.random.normal(jax.random.fold_in(key, 1), (1, h8, w8, C))
+
+    def run_for(make_lookup):
+        @jax.jit
+        def run(f1, f2):
+            lkp, lkp2 = make_lookup(f1, f2)
+            coords = coords_grid(1, h8, w8)
+
+            def body(co, _):
+                s = lkp(co) + lkp2(co)
+                co = co + 0.01 * s.mean(axis=-1, keepdims=True)
+                return co, None
+
+            co, _ = jax.lax.scan(body, coords, None, length=iters)
+            return jnp.sum(co)
+
+        return run
+
+    def time_leg(name, make_lookup):
+        run = run_for(make_lookup)
+        float(run(f1, f2))
+        t0 = time.perf_counter()
+        reps = 3 if on_tpu else 1
+        for _ in range(reps):
+            float(run(f1, f2))
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name:>10s}: {dt * 1e3:8.1f} ms total, "
+              f"{dt / iters * 1e3:6.2f} ms/iter")
+
+    time_leg("allpairs", lambda a, b: (build_corr_pyramid(a, b, 4, RADIUS),
+                                       build_corr_pyramid(b, a, 4, RADIUS)))
+    time_leg("pallas", lambda a, b: (
+        build_local_corr(a, b, 4, RADIUS, kernel="pallas"),
+        build_local_corr(b, a, 4, RADIUS, kernel="pallas")))
+    time_leg("flash", lambda a, b: (
+        build_local_corr(a, b, 4, RADIUS, kernel="flash"),
+        build_local_corr(b, a, 4, RADIUS, kernel="flash")))
+
+
 def main():
     ap = argparse.ArgumentParser(
         "lookup_ab", description="corr-lookup A/B experiment rounds")
-    ap.add_argument("--variant", type=int, choices=[1, 2, 3], default=1,
+    ap.add_argument("--variant", type=int, choices=[1, 2, 3, 4], default=1,
                     help="1 = formulation A/B, 2 = contraction-order / "
-                         "instance-overhead round, 3 = bf16-input round")
+                         "instance-overhead round, 3 = bf16-input round, "
+                         "4 = allpairs vs per-pixel pallas vs "
+                         "flash-blocked")
     args = ap.parse_args()
     print(f"platform={jax.devices()[0].platform}", file=sys.stderr)
-    {1: main_v1, 2: main_v2, 3: main_v3}[args.variant]()
+    {1: main_v1, 2: main_v2, 3: main_v3, 4: main_v4}[args.variant]()
 
 
 if __name__ == "__main__":

@@ -11,6 +11,13 @@ block through the relay tunnel):
                (--corr_dtype sweep; each line also reports the estimated
                correlation bytes each lookup streams from HBM — the
                quantization win made legible even on the CPU fallback)
+  flash32_<dt> the same chained loop through the flash-blocked kernel
+               (ops/pallas_corr.py, ISSUE 12): no materialized volume —
+               its bytes column is the O(fmaps) streaming BOUND, vs the
+               O(N^2) volume bytes of lkp32. Interpreter-mode
+               (debug-speed) on the CPU fallback; with lookup_ab
+               --variant 4 the pinned records now cover all three
+               formulations (allpairs / per-pixel pallas / flash)
   forward      the full v5 test-mode forward (sanity: ~ sum of the above)
   fwd_iter1    iters=1 forward -> per-iteration + prelude split
   fwd_sp_unr4  candidate config: scan_unroll=4 (XLA software pipelining)
@@ -151,6 +158,46 @@ def main() -> None:
         print(f"  -> {dt}: {mb:8.1f} MB corr bytes/lookup, "
               f"{t_q / ITERS * 1e3:6.1f} ms/iter "
               f"({mb / max(t_q / ITERS, 1e-9) / 1e3:6.2f} GB/s implied)")
+
+    # --- the flash-blocked formulation at the same dtypes (ISSUE 12):
+    # fmap2 stays in HBM and streams in row blocks, so the volume bytes
+    # above disappear entirely — the printed bound is the whole fmap
+    # set, the most a lookup can stream. Off-TPU the kernel runs in
+    # interpreter mode (debug-speed; timings prove the path is
+    # compile-flat and transfer-clean, nothing more) ---
+    import os
+
+    from dexiraft_tpu.ops.quant import corr_dtype_bytes
+    from dexiraft_tpu.ops.local_corr import build_local_corr
+
+    if jax.devices()[0].platform != "tpu":
+        os.environ.setdefault("DEXIRAFT_PALLAS_INTERPRET", "1")
+    for dt in dtypes:
+        def flash32_q(f1, f2, dt=dt):
+            lc = build_local_corr(f1, f2, 4, 4, dtype=dt, kernel="flash")
+            lc2 = build_local_corr(f2, f1, 4, 4, dtype=dt, kernel="flash")
+            coords = coords_grid(1, h8, w8)
+
+            def body(co, _):
+                s = lc(co)
+                s2 = lc2(co)
+                co = co + 0.01 * (s.mean(axis=-1, keepdims=True)
+                                  + s2.mean(axis=-1, keepdims=True))
+                return co, None
+
+            co, _ = jax.lax.scan(body, coords, None, length=ITERS)
+            return co
+
+        t_f = timeit(f"flash32_{dt}", flash32_q, f1, f2, strict=True)
+        n = h8 * w8
+        pyr_cells = sum((n >> (2 * i)) * c for i in range(4))
+        # fmap1 is read fp32; the fmap2 pyramid streams in the storage
+        # dtype — and only the row blocks the windows touch, so this is
+        # an upper bound, not an estimate
+        mb = 2 * (n * c * 4 + pyr_cells * corr_dtype_bytes(dt)) / 1e6
+        print(f"  -> {dt}: <= {mb:6.1f} MB fmap bytes/lookup "
+              f"(O(fmaps) bound — no volume), "
+              f"{t_f / ITERS * 1e3:6.1f} ms/iter")
     if args.corr_sweep_only:
         return
 

@@ -54,13 +54,13 @@ def main():
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--remat_lookup", action="store_true")
     ap.add_argument("--corr_impl", default="allpairs",
-                    choices=["allpairs", "local", "pallas"])
+                    choices=["allpairs", "local", "pallas", "flash"])
     ap.add_argument("--corr_dtype", choices=["fp32", "bf16"], default="fp32",
                     help="correlation-pyramid storage precision (int8 is "
                          "inference-only, so not offered here)")
     ap.add_argument("--fused_update", action="store_true",
                     help="fused Pallas lookup+update step kernel "
-                         "(requires --corr_impl pallas)")
+                         "(requires --corr_impl flash or pallas)")
     ap.add_argument("--compile_cache_dir", default=None,
                     help="persistent XLA cache dir "
                          "(default logs/xla_cache)")
@@ -78,8 +78,8 @@ def main():
                          "tunnel is down; config.update beats the "
                          "axon site-hook pin)")
     args = ap.parse_args()
-    if args.fused_update and args.corr_impl != "pallas":
-        ap.error("--fused_update requires --corr_impl pallas")
+    if args.fused_update and args.corr_impl not in ("pallas", "flash"):
+        ap.error("--fused_update requires --corr_impl flash or pallas")
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 

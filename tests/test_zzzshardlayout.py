@@ -250,7 +250,8 @@ class TestSpecLayout:
 
         expected = {"replicated", "params", "opt_state", "fsdp_params",
                     "batch", "batch_spatial", "carry", "corr_query_rows",
-                    "batch_for", "corr_volume", "data_size", "has_seq"}
+                    "batch_for", "corr_volume", "corr_fmaps", "data_size",
+                    "has_seq"}
         public = {n for n in dir(SpecLayout) if not n.startswith("_")
                   and callable(getattr(SpecLayout, n))}
         assert public == expected
@@ -268,6 +269,7 @@ class TestSpecLayout:
         assert spec_str(LAYOUT.batch_for(m1)) == "P('data')"
         assert spec_str(LAYOUT.batch_for(m2)) == "P('data', 'seq')"
         assert spec_str(LAYOUT.corr_volume(m2)) == "P('data', 'seq')"
+        assert spec_str(LAYOUT.corr_fmaps(m2)) == "P('data', 'seq')"
         assert LAYOUT.data_size(m2) == 4
         assert LAYOUT.has_seq(m2) and not LAYOUT.has_seq(m1)
 
@@ -318,12 +320,19 @@ class TestGoldenFile:
         assert g["steps"]["train"]["mesh"] == shardaudit.TRAIN_MESH
         assert g["steps"]["serve"]["mesh"] == shardaudit.SERVE_MESH
 
-    def test_corr_volume_canary_is_sharded(self):
-        """THE point of the audit: the ~200 MB all-pairs volume must
-        never be pinned replicated."""
-        g = _golden()["declared"]["corr_volume"]
+    def test_volume_free_golden_with_fmap_canary(self):
+        """ISSUE 12 pin: the production eval/serve config is the flash-
+        blocked kernel, so the audit passes WITHOUT the materialized
+        all-pairs volume — the corr_volume declared group is gone, and
+        the canary is armed on the streamed fmap set instead (still
+        over the 64 MB tripwire if ever pinned replicated)."""
+        declared = _golden()["declared"]
+        assert "corr_volume" not in declared
+        g = declared["corr_fmaps"]
         assert not g["replicated"] and not g["flagged"]
-        assert g["total_mb"] > 100  # it IS the big array
+        assert g["total_mb"] > shardaudit.DEFAULT_THRESHOLD_MB
+        # the remaining groups keep the tripwire armed
+        assert {"batch", "carry", "params", "opt_state"} <= set(declared)
 
     def test_params_replicated_by_design(self):
         g = _golden()["declared"]["params"]
@@ -371,21 +380,21 @@ class TestGoldenDiff:
     def test_declared_replication_change_is_drift(self):
         g = _golden()
         mutated = copy.deepcopy(g)
-        mutated["declared"]["corr_volume"]["spec"] = "P()"
-        mutated["declared"]["corr_volume"]["replicated"] = True
+        mutated["declared"]["corr_fmaps"]["spec"] = "P()"
+        mutated["declared"]["corr_fmaps"]["replicated"] = True
         assert shardaudit.diff_golden(mutated, g)
 
     def test_flagged_groups(self):
         report = {"declared": {
-            "corr_volume": {"spec": "P()", "total_mb": 189.1,
-                            "per_device_mb": 189.1, "replicated": True,
-                            "flagged": True},
+            "corr_fmaps": {"spec": "P()", "total_mb": 128.1,
+                           "per_device_mb": 128.1, "replicated": True,
+                           "flagged": True},
             "params": {"spec": "P()", "total_mb": 20.0,
                        "per_device_mb": 20.0, "replicated": True,
                        "flagged": False},
         }}
         flagged = shardaudit.flagged_groups(report)
-        assert len(flagged) == 1 and "corr_volume" in flagged[0]
+        assert len(flagged) == 1 and "corr_fmaps" in flagged[0]
 
 
 class TestAuditCLI:
@@ -426,7 +435,7 @@ class TestAuditCLI:
 
         def flagged(steps, threshold_mb):
             r = copy.deepcopy(_golden())
-            r["declared"]["corr_volume"].update(
+            r["declared"]["corr_fmaps"].update(
                 spec="P()", replicated=True, flagged=True)
             return r
 
