@@ -118,6 +118,23 @@ class RecompileWatch:
                 f"retraced (shape/dtype drift). Enable jax.log_compiles() "
                 f"to see what; docs/static_analysis.md has the playbook")
 
+    @contextlib.contextmanager
+    def sanctioned(self) -> Iterator[None]:
+        """Absorb the compiles of a sanctioned window — the compile-side
+        twin of ``jax.transfer_guard("allow")`` around planned host I/O.
+
+        The baseline shifts by exactly the window's compile count, so
+        drift observed OUTSIDE the window still counts: a checkpoint
+        save's one-time per-shape device copies (the fsdp per-shard
+        snapshot) pass, a train-step retrace before or after does not.
+        No-op before ``mark_warm()``."""
+        before = compile_count()
+        try:
+            yield
+        finally:
+            if self._warm_at is not None:
+                self._warm_at += compile_count() - before
+
     def warn_if_drifted(self, file=None) -> bool:
         """One-line, once-only warning when post-warmup compiles exist.
 

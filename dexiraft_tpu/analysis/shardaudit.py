@@ -18,9 +18,18 @@ resolved sharding off the compiled executables, and
     fully replicated and is not pinned as replicated-by-design in
     ``parallel.layout.REPLICATED_OK``.
 
+Two goldens since the fsdp axis went live: ``layout_golden.json`` pins
+the data x seq (and serve) legs exactly as before, and
+``layout_golden_fsdp.json`` pins the train step on the virtual
+{data x fsdp x seq} mesh — params/opt_state resolved to their per-leaf
+fsdp storage shardings, divisibility-fallback leaves replicated, and
+the over-threshold replicated canary armed on them with no
+REPLICATED_OK exemption.
+
 Run it via ``scripts/shard_audit.py`` (which forces the host platform
 before jax initializes); the tier-1 verify command runs it right after
-``lint_gate.py``. Regeneration workflow: docs/static_analysis.md.
+``lint_gate.py`` and audits BOTH goldens by default. Regeneration
+workflow: docs/static_analysis.md.
 
 Granularity note: shardings are reported per GROUP (a state field, a
 batch key — e.g. ``[0].params`` or ``[1]['image1']``), each carrying
@@ -39,6 +48,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "layout_golden.json")
+#: The fsdp leg's golden: the train step compiled on a virtual
+#: {data x fsdp x seq} mesh, params/opt_state resolved to their fsdp
+#: storage shardings (per-leaf, divisibility fallback included) plus the
+#: declared groups re-resolved on that mesh. A separate file so the
+#: data x seq golden's semantics stay untouched.
+FSDP_GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "layout_golden_fsdp.json")
 
 #: Audit geometry: small model + tiny frames keep the three compiles
 #: ~a minute on CPU; the SPECS resolved are geometry-independent.
@@ -55,6 +71,10 @@ DEFAULT_THRESHOLD_MB = 64.0
 #: serve mesh, as {axis: size} over the 8 forced host devices.
 TRAIN_MESH = {"data": 4, "seq": 2}
 SERVE_MESH = {"data": 8}
+#: The fsdp leg's mesh: all three axes live on the same 8 devices, so
+#: the golden pins how the fsdp storage shardings compose with data and
+#: seq compute sharding in one compile.
+FSDP_MESH = {"data": 2, "fsdp": 2, "seq": 2}
 
 
 def _group_key(path: Tuple[Any, ...]) -> str:
@@ -151,6 +171,22 @@ def audit_train(mesh=None) -> Dict[str, Any]:
     return {"mesh": _mesh_dict(mesh), **sections}
 
 
+def audit_train_fsdp(mesh=None) -> Dict[str, Any]:
+    """The fsdp leg: the SAME donated train step compiled on the
+    {data x fsdp x seq} mesh. The resolved in/out state shardings are
+    the storage layout (params/opt_state per-leaf over 'fsdp', small
+    leaves replicated by the layout's divisibility fallback); the batch
+    keeps P('data', 'seq') — fsdp is storage, not compute (the step's
+    gather fences), so the compute sections must look exactly like the
+    data x seq leg's apart from the state groups."""
+    from dexiraft_tpu.parallel.layout import make_mesh_fsdp
+
+    if mesh is None:
+        mesh = make_mesh_fsdp(FSDP_MESH["data"], FSDP_MESH["fsdp"],
+                              FSDP_MESH["seq"])
+    return audit_train(mesh)
+
+
 def _audit_eval_step(mesh) -> Dict[str, Any]:
     """Shared body for the eval and serve audits — same forward step,
     different mesh (2-D train mesh vs 1-D serve mesh).
@@ -206,13 +242,16 @@ def audit_serve(mesh=None) -> Dict[str, Any]:
     return _audit_eval_step(mesh)
 
 
-def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB
-                    ) -> Dict[str, Any]:
+def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB,
+                    mesh=None) -> Dict[str, Any]:
     """Resolve the layout's declared array groups at the PRODUCTION
     reference geometry: per-group canonical spec, total bytes, bytes
     per device, and the replicated-over-threshold flag. This is where
-    the ~200 MB correlation-volume canary lives — it is an intermediate
-    the in/out sections can never see."""
+    the size canaries live — intermediates (corr_fmaps) and persistent
+    state (params/opt_state, which since the fsdp axis went live carry
+    NO replicated-by-design exemption: on an fsdp mesh they resolve
+    sharded, and a layout change that pins them replicated over the
+    threshold fails the audit)."""
     from dexiraft_tpu.parallel.layout import (
         LAYOUT,
         REPLICATED_OK,
@@ -220,7 +259,8 @@ def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB
         spec_str,
     )
 
-    mesh = make_mesh_2d(TRAIN_MESH["data"], TRAIN_MESH["seq"])
+    if mesh is None:
+        mesh = make_mesh_2d(TRAIN_MESH["data"], TRAIN_MESH["seq"])
     h, w = PROD_IMAGE
     b = PROD_BATCH
     hw8 = (h // 8) * (w // 8)
@@ -244,8 +284,8 @@ def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB
         ("carry", LAYOUT.carry(), b * hw8 * 2 * 4),
         ("corr_fmaps", LAYOUT.corr_fmaps(mesh),
          fmap_bytes + pyramid_bytes),
-        ("params", LAYOUT.params(), 5_300_000 * 4),
-        ("opt_state", LAYOUT.opt_state(), 2 * 5_300_000 * 4),
+        ("params", LAYOUT.params(mesh), 5_300_000 * 4),
+        ("opt_state", LAYOUT.opt_state(mesh), 2 * 5_300_000 * 4),
     ]
     mesh_shape = dict(mesh.shape)
     out = {}
@@ -271,23 +311,52 @@ def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB
 
 STEP_AUDITS = {"train": audit_train, "eval": audit_eval,
                "serve": audit_serve}
+#: Steps audited against the SEPARATE fsdp golden (FSDP_GOLDEN_PATH).
+FSDP_STEP_AUDITS = {"train_fsdp": audit_train_fsdp}
 
 
-def run_audit(steps: Sequence[str] = ("train", "eval", "serve"),
-              threshold_mb: float = DEFAULT_THRESHOLD_MB) -> Dict[str, Any]:
+def _report_header() -> Dict[str, Any]:
     from dexiraft_tpu.parallel.layout import LAYOUT
 
-    report: Dict[str, Any] = {
+    return {
         "version": 1,
         "axes": {"data": LAYOUT.data_axis, "fsdp": LAYOUT.fsdp_axis,
                  "seq": LAYOUT.seq_axis},
         "audit_image": list(AUDIT_IMAGE),
         "audit_batch": AUDIT_BATCH,
+    }
+
+
+def run_audit(steps: Sequence[str] = ("train", "eval", "serve"),
+              threshold_mb: float = DEFAULT_THRESHOLD_MB) -> Dict[str, Any]:
+    report: Dict[str, Any] = {
+        **_report_header(),
         "steps": {},
         "declared": declared_groups(threshold_mb),
     }
     for name in steps:
         report["steps"][name] = STEP_AUDITS[name]()
+    return report
+
+
+def run_audit_fsdp(steps: Sequence[str] = ("train_fsdp",),
+                   threshold_mb: float = DEFAULT_THRESHOLD_MB
+                   ) -> Dict[str, Any]:
+    """The fsdp report, diffed against FSDP_GOLDEN_PATH: the train step
+    on the {data x fsdp x seq} mesh plus the declared groups re-resolved
+    there — params/opt_state show P('fsdp') with replicated=False, and
+    the over-threshold canary stays armed with no exemption."""
+    from dexiraft_tpu.parallel.layout import make_mesh_fsdp
+
+    mesh = make_mesh_fsdp(FSDP_MESH["data"], FSDP_MESH["fsdp"],
+                          FSDP_MESH["seq"])
+    report: Dict[str, Any] = {
+        **_report_header(),
+        "steps": {},
+        "declared": declared_groups(threshold_mb, mesh=mesh),
+    }
+    for name in steps:
+        report["steps"][name] = FSDP_STEP_AUDITS[name]()
     return report
 
 

@@ -3,8 +3,12 @@
 The reference's entire parallelism story is single-process
 torch.nn.DataParallel (train.py:139, SURVEY.md §2.7). The TPU-native
 equivalent is declarative: build a jax.sharding.Mesh over the chips,
-shard the batch over the layout's data axis, replicate parameters, and
-let the SPMD partitioner insert the gradient all-reduce over ICI.
+shard the batch over the layout's data axis, and let the SPMD
+partitioner insert the gradient all-reduce over ICI. Parameters and
+optimizer state replicate by default, or live SHARDED over the live
+``fsdp`` axis (``make_train_mesh(batch, fsdp=...)`` — storage
+sharding with per-shard checkpoints; the train step gathers at entry,
+docs/parallel.md).
 
 ``parallel.layout`` is the single source of truth: the frozen
 :class:`SpecLayout` owns every mesh axis name and canonical
@@ -18,9 +22,12 @@ from dexiraft_tpu.parallel.layout import (
     LAYOUT,
     SpecLayout,
     batch_sharding,
+    gather_state,
     make_mesh,
     replicated_sharding,
     shard_batch,
+    shard_state,
+    state_sharding,
 )
 
 __all__ = [
@@ -28,7 +35,10 @@ __all__ = [
     "LAYOUT",
     "SpecLayout",
     "batch_sharding",
+    "gather_state",
     "make_mesh",
     "replicated_sharding",
     "shard_batch",
+    "shard_state",
+    "state_sharding",
 ]

@@ -24,12 +24,24 @@ Axes (``SpecLayout``):
   seq   — context parallelism: image rows (and with them the quadratic
           correlation volume's query axis) shard over it on 2-D train
           meshes (parallel/context.py has the math).
-  fsdp  — RESERVED for pod-scale parameter sharding. No current mesh
-          instantiates it; params/optimizer state replicate today
-          (declared in ``REPLICATED_OK`` so the audit's
-          large-replicated-array tripwire exempts them knowingly).
-          When a mesh grows the axis, ``fsdp_params()`` is the one
-          place the param spec changes.
+  fsdp  — parameter/optimizer-state sharding (LIVE since the fsdp PR).
+          ``make_train_mesh(batch, fsdp=...)`` grows the axis over the
+          devices left after data takes its largest batch divisor;
+          ``params(mesh)``/``opt_state(mesh)`` resolve to the fsdp spec
+          on such meshes, with the per-leaf divisibility fallback
+          decided HERE (``param_leaf_spec``) — small leaves (biases,
+          norm params, scalars) and leaves with no dividing dim stay
+          replicated, and call sites never decide.
+
+fsdp is a STORAGE axis, not a compute axis: the train step gathers the
+state to replicated at entry and re-shards at exit (train/step.py's
+fence pattern — see docs/perf.md "Sharded state (fsdp)" for why the
+partitioner must never see fsdp-sharded tensors inside the model:
+feature-dim-partitioned convolutions miscompile under this backend's
+GSPMD, pinned by tests/test_zzzfsdp.py). The persistent HBM win —
+params + Adam moments at ~1/N per device between steps, and per-shard
+checkpoint I/O — is exactly what the ``state_bytes_per_device`` bench
+metric records.
 
 The compat surface ``parallel/mesh.py`` re-exports everything below, so
 existing imports keep working; new code should import from here.
@@ -65,25 +77,65 @@ class SpecLayout:
 
     # ---- mesh-independent specs ---------------------------------------
 
+    #: Leaves smaller than this (elements) stay replicated on fsdp
+    #: meshes: biases, norm scales, and scalars cost more to gather
+    #: than they save, and their shard would be sub-tile anyway.
+    FSDP_MIN_LEAF_SIZE = 4096
+
     def replicated(self) -> PartitionSpec:
-        """Fully replicated: params, optimizer state, scalars, metrics."""
+        """Fully replicated: scalars, metrics, BN stats — and params/
+        opt_state on meshes without an fsdp axis."""
         return PartitionSpec()
 
-    def params(self) -> PartitionSpec:
-        """Model parameters. Replicated today (no fsdp mesh yet) —
-        listed in REPLICATED_OK so the audit accepts it knowingly."""
-        return PartitionSpec()
+    def params(self, mesh: Optional[Mesh] = None) -> PartitionSpec:
+        """Model parameters: the canonical GROUP spec. Replicated on
+        meshes without an fsdp axis; ``fsdp_params()`` on meshes with
+        one. Per-LEAF resolution (which dim, divisibility fallback) is
+        :meth:`param_leaf_spec` — this group-level answer is what the
+        audit's declared section and the docs tables pin."""
+        if mesh is None or self.fsdp_axis not in mesh.axis_names:
+            return PartitionSpec()
+        return self.fsdp_params()
 
-    def opt_state(self) -> PartitionSpec:
-        """Optimizer state mirrors the param layout."""
-        return self.params()
+    def opt_state(self, mesh: Optional[Mesh] = None) -> PartitionSpec:
+        """Optimizer state mirrors the param layout (Adam's mu/nu are
+        param-shaped; the step counter falls back to replicated via the
+        per-leaf policy like every other small leaf)."""
+        return self.params(mesh)
 
     def fsdp_params(self) -> PartitionSpec:
-        """Pod-scale param spec: leading dim sharded over 'fsdp'. No
-        current mesh has the axis; this is the declared migration
-        target, not a live spec (the audit golden pins params
-        replicated until a mesh instantiates fsdp)."""
+        """The canonical fsdp GROUP marker spec: sharded over 'fsdp'.
+        Real leaves resolve per-dim via :meth:`param_leaf_spec` (a conv
+        kernel's dividing dim is rarely the leading one)."""
         return PartitionSpec(self.fsdp_axis)
+
+    def param_leaf_spec(self, mesh: Mesh,
+                        shape: Sequence[int]) -> PartitionSpec:
+        """Per-leaf fsdp resolution — THE divisibility-fallback policy,
+        decided centrally so no call site ever reimplements it.
+
+        Shards the LARGEST dim that the mesh's fsdp axis divides
+        (ties: the earliest). Conv kernels are HWIO — their leading
+        dims are 1/3/7-sized taps, so a leading-dim-only rule would
+        exempt the entire model; the largest dim is a channel dim.
+        Falls back to replicated for leaves under FSDP_MIN_LEAF_SIZE
+        (biases, norm params, scalars) and leaves no dim of which
+        divides the axis — exactly the leaves whose gather would cost
+        more than their shard saves."""
+        n = self.fsdp_size(mesh)
+        shape = tuple(int(s) for s in shape)
+        if n <= 1 or int(np.prod(shape, dtype=np.int64)) < \
+                self.FSDP_MIN_LEAF_SIZE:
+            return PartitionSpec()
+        best = None
+        for i, d in enumerate(shape):
+            if d and d % n == 0 and (best is None or d > shape[best]):
+                best = i
+        if best is None:
+            return PartitionSpec()
+        entry: "list" = [None] * len(shape)
+        entry[best] = self.fsdp_axis
+        return PartitionSpec(*entry)
 
     def batch(self) -> PartitionSpec:
         """Batch leaves on a 1-D mesh: leading (batch) dim over 'data'."""
@@ -149,15 +201,28 @@ class SpecLayout:
     def has_seq(self, mesh: Mesh) -> bool:
         return self.seq_axis in mesh.axis_names
 
+    def has_fsdp(self, mesh: Mesh) -> bool:
+        """True when the mesh instantiates a >1-way fsdp axis (a 1-way
+        axis is storage-identical to replicated, so callers skip the
+        gather fences for it)."""
+        return self.fsdp_size(mesh) > 1
+
+    def fsdp_size(self, mesh: Mesh) -> int:
+        """Number of ways params/opt_state shard on this mesh."""
+        return dict(mesh.shape).get(self.fsdp_axis, 1)
+
 
 #: The one layout instance application code threads around.
 LAYOUT = SpecLayout()
 
 #: Logical array groups the shard audit may see fully replicated without
-#: flagging, with the reason pinned next to the exemption.
+#: flagging, with the reason pinned next to the exemption. params and
+#: opt_state are deliberately NOT here anymore: since the fsdp axis went
+#: live they resolve to the fsdp spec on fsdp meshes, and on data-only
+#: meshes they sit under the size threshold — the over-threshold
+#: replicated canary is ARMED on them (an opt_state that ever resolves
+#: fully replicated above the tripwire fails the audit, no exemption).
 REPLICATED_OK = {
-    "params": "replicated by design until a mesh instantiates 'fsdp'",
-    "opt_state": "mirrors the param layout (see params)",
     "batch_stats": "BatchNorm running stats are global (sync-BN)",
     "rng": "scalar-sized PRNG key",
     "step": "scalar step counter",
@@ -211,17 +276,86 @@ def make_mesh_2d(
     return Mesh(grid, (LAYOUT.data_axis, LAYOUT.seq_axis))
 
 
-def make_train_mesh(batch_size: int,
-                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """The training CLI's mesh policy (was inline glue in train_cli):
-    a 1-D data mesh over the largest device count that divides the
-    batch — a 10-batch on 8 chips uses 2; pick batch sizes that are
-    multiples of the slice size to use every chip."""
+def make_mesh_fsdp(
+    n_data: int,
+    n_fsdp: int,
+    n_seq: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(data, fsdp[, seq]) mesh: batch DP x parameter sharding [x CP].
+
+    The fsdp axis holds params and optimizer state sharded between
+    steps (param_leaf_spec); the batch still shards over 'data' (and
+    rows over 'seq'), replicated across fsdp — fsdp is storage
+    parallelism, gathered for compute by the train step's fences.
+
+    Placement: the INNERMOST axis gets adjacent devices. On a 2-axis
+    (data, fsdp) mesh that is fsdp, so the entry gather rides ICI
+    neighbors; with ``n_seq`` it is seq — fsdp groups then stride by
+    n_seq, deliberately: seq carries a halo exchange per sharded conv
+    inside every step (make_mesh_2d's placement argument), while the
+    fsdp gather happens once at step entry, so seq keeps the neighbor
+    links when both want them.
+    """
     if devices is None:
         devices = jax.devices()
-    n_use = max(n for n in range(1, len(devices) + 1)
-                if batch_size % n == 0)
-    return make_mesh(devices[:n_use])
+    shape = (n_data, n_fsdp) + (() if n_seq is None else (n_seq,))
+    total = int(np.prod(shape))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {'x'.join(str(s) for s in shape)} needs {total} "
+            f"devices, have {len(devices)}")
+    axes = (LAYOUT.data_axis, LAYOUT.fsdp_axis) + (
+        () if n_seq is None else (LAYOUT.seq_axis,))
+    grid = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(grid, axes)
+
+
+def make_train_mesh(batch_size: int,
+                    devices: Optional[Sequence[jax.Device]] = None,
+                    fsdp: "Optional[object]" = None) -> Mesh:
+    """The training CLI's mesh policy (was inline glue in train_cli).
+
+    data axis: the largest device count that divides the batch — pick
+    batch sizes that are multiples of the slice size to use every chip
+    for data parallelism.
+
+    fsdp axis (``fsdp=``):
+      * None / 1 — no fsdp axis: the historical 1-D data mesh.
+      * 'auto'   — largest divisor after data: the axis grows over the
+        devices data-parallelism left idle (a 2-batch on 8 chips:
+        data=2, fsdp=4), host-count-aware — the size is walked down to
+        one that keeps each fsdp shard group within whole host blocks
+        (divides, or is a multiple of, the local device count) so the
+        step-entry gather rides intra-host ICI.
+      * int N    — exactly N-way fsdp: the axis is carved FIRST and
+        data takes the largest batch divisor of the remaining budget
+        (an 8-batch on 8 chips with fsdp=4 trains data=2 x fsdp=4) —
+        the explicit form benches and A/B tests use.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_data = max(n for n in range(1, len(devices) + 1)
+                 if batch_size % n == 0)
+    if fsdp is None or fsdp == 1:
+        return make_mesh(devices[:n_data])
+    if fsdp == "auto":
+        n_fsdp = len(devices) // n_data
+        local = max(1, jax.local_device_count())
+        while n_fsdp > 1 and not (local % n_fsdp == 0
+                                  or n_fsdp % local == 0):
+            n_fsdp -= 1
+    else:
+        n_fsdp = int(fsdp)
+        if n_fsdp < 1 or n_fsdp > len(devices):
+            raise ValueError(
+                f"fsdp={n_fsdp}: need between 1 and {len(devices)} "
+                f"device(s)")
+        n_data = max(n for n in range(1, len(devices) // n_fsdp + 1)
+                     if batch_size % n == 0)
+    if n_fsdp <= 1:
+        return make_mesh(devices[:n_data])
+    return make_mesh_fsdp(n_data, n_fsdp, devices=devices)
 
 
 def make_serve_mesh(n_chips: Optional[int] = None) -> Mesh:
@@ -275,6 +409,67 @@ def batch_input_sharding(mesh: Mesh) -> NamedSharding:
 def carry_sharding(mesh: Mesh) -> NamedSharding:
     """Warm-start carry (flow_init / flow_low) sharding."""
     return named(mesh, LAYOUT.carry())
+
+
+#: TrainState fields whose leaves shard over fsdp; everything else in
+#: the state (step, rng, batch_stats — see REPLICATED_OK) replicates.
+_FSDP_STATE_FIELDS = ("params", "opt_state")
+
+
+def state_sharding(mesh: Mesh, state: Any) -> Any:
+    """Per-leaf NamedSharding tree for a TrainState-shaped pytree.
+
+    On fsdp meshes, leaves under the ``params``/``opt_state`` fields
+    resolve via LAYOUT.param_leaf_spec (largest dividing dim, small-leaf
+    fallback); every other field — and every field on non-fsdp meshes —
+    is replicated. ``state`` may be abstract (jax.eval_shape output):
+    only shapes are read. This is THE tree the train step pins as its
+    state in/out shardings and the one shard_state puts with, so
+    storage layout and the jit boundary can never drift apart."""
+    repl = replicated_sharding(mesh)
+    if not LAYOUT.has_fsdp(mesh):
+        return jax.tree.map(lambda _: repl, state)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    shardings = []
+    for path, leaf in flat:
+        field = getattr(path[0], "name", None)
+        if field in _FSDP_STATE_FIELDS:
+            shardings.append(
+                named(mesh, LAYOUT.param_leaf_spec(mesh, np.shape(leaf))))
+        else:
+            shardings.append(repl)
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def shard_state(state: Any, mesh: Mesh) -> Any:
+    """Device-put a host/replicated TrainState into its storage layout
+    (state_sharding). Multi-process safe: sharded leaves assemble via
+    make_array_from_callback — every host holds the full host-side copy
+    (create_state is deterministic per host) and contributes the slices
+    its devices own."""
+    shardings = state_sharding(mesh, state)
+
+    def put(x: Any, sharding: NamedSharding) -> jax.Array:
+        if sharding.spec == PartitionSpec():
+            return _put(x, sharding)
+        host = np.asarray(x)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    return jax.tree.map(put, state, shardings)
+
+
+def gather_state(tree: Any, mesh: Mesh) -> Any:
+    """Explicit all-gather of a (possibly fsdp-sharded) pytree back to
+    replicated — the host-side companion of the train step's entry
+    fence, used where sharded leaves must not reach a consumer that
+    compiles without the fences (validation's eval step, interop
+    exports). No-op cost on already-replicated leaves."""
+    repl = replicated_sharding(mesh)
+    return jax.tree.map(
+        lambda x: (jax.device_put(x, repl)
+                   if isinstance(x, jax.Array)
+                   and not x.is_fully_replicated else x), tree)
 
 
 # --------------------------------------------------------------------------

@@ -22,8 +22,10 @@ from dexiraft_tpu.parallel.layout import (  # noqa: F401
     batch_putter,
     batch_sharding,
     carry_sharding,
+    gather_state,
     make_mesh,
     make_mesh_2d,
+    make_mesh_fsdp,
     make_serve_mesh,
     make_train_mesh,
     named,
@@ -31,7 +33,9 @@ from dexiraft_tpu.parallel.layout import (  # noqa: F401
     replicated_sharding,
     shard_batch,
     shard_batch_spatial,
+    shard_state,
     spatial_sharding,
+    state_sharding,
 )
 
 __all__ = [
@@ -44,8 +48,10 @@ __all__ = [
     "batch_putter",
     "batch_sharding",
     "carry_sharding",
+    "gather_state",
     "make_mesh",
     "make_mesh_2d",
+    "make_mesh_fsdp",
     "make_serve_mesh",
     "make_train_mesh",
     "named",
@@ -53,5 +59,7 @@ __all__ = [
     "replicated_sharding",
     "shard_batch",
     "shard_batch_spatial",
+    "shard_state",
     "spatial_sharding",
+    "state_sharding",
 ]

@@ -6,9 +6,11 @@ and PRNG key all round-trip, so resume continues the OneCycle schedule
 instead of restarting it (the reference's documented gap, SURVEY.md §5).
 
 ``save_checkpoint(block=False)`` is the pod-grade save path: the state is
-snapshotted to host synchronously (a device_get — the ONLY part the step
-loop waits for, and the snapshot is what makes the handoff safe against
-the donated train step invalidating the device buffers) and the flush
+snapshotted synchronously (the ONLY part the step loop waits for, and
+what makes the handoff safe against the donated train step invalidating
+the device buffers — replicated leaves device_get to host, fsdp-sharded
+leaves take an on-device per-shard copy that orbax's sharding-aware
+serializer then writes one addressable shard per host) and the flush
 (serialize + disk write + atomic commit) runs on a single background
 thread. ``wait_pending`` is the barrier, taken before anything that
 reads or mutates the directory — the next save, a rollback restore,
@@ -153,28 +155,26 @@ def _data_to_keys(tree: Any, template: Any) -> Any:
 # --- async save machinery -------------------------------------------------
 
 def _host_snapshot(tree: Any) -> Any:
-    """Host copy of every leaf (numpy), with a CLEAR error for state the
-    snapshot cannot capture.
+    """Donation-safe snapshot of every leaf, taken on the caller's
+    thread before the flush is handed off.
 
-    device_get succeeds for anything with a local copy: host/numpy
-    values, single-process arrays, and multi-host REPLICATED or
-    host-addressably-sharded arrays (today's layout — REPLICATED_OK
-    pins params/opt_state as replicated). A leaf truly sharded ACROSS
-    hosts (the reserved fsdp axis, parallel/layout.fsdp_params) has no
-    local copy; snapshotting it needs orbax's per-addressable-shard
-    async path, not a device_get — refuse loudly now rather than let
-    the first pod-scale fsdp save die inside jax with a generic
-    'spans non-addressable devices'."""
+    Replicated / host / numpy leaves snapshot as before: one device_get
+    to a numpy copy, so the background flush on them is pure host I/O.
+
+    SHARDED leaves (the live fsdp axis, parallel/layout.state_sharding
+    — including cross-host shards, which have no full local copy to
+    device_get) snapshot as an on-device copy instead: a distinct
+    buffer the donated train step cannot invalidate, still in the
+    leaf's sharding. The background flush hands it to orbax's
+    sharding-aware serializer, which writes only each host's
+    addressable shards (the per-shard path) under the same atomic
+    commit — so a pod-scale fsdp save costs 1/N of the array per
+    device, never a full gather."""
     def snap(x: Any) -> Any:
-        if isinstance(x, jax.Array) and not (
-                x.is_fully_addressable or x.is_fully_replicated):
-            raise NotImplementedError(
-                "save_checkpoint snapshots state to host before the "
-                "async flush, and this leaf is sharded across hosts "
-                "(no local copy). Cross-host-sharded (fsdp) state "
-                "needs the per-shard orbax async path — extend "
-                "train.checkpoint before sharding params over "
-                "parallel/layout's fsdp axis.")
+        if isinstance(x, jax.Array) and not x.is_fully_replicated:
+            # jnp.copy follows the operand's sharding: a real per-shard
+            # device-side copy, no cross-device traffic
+            return jnp.copy(x)
         return jax.device_get(x)
 
     return jax.tree.map(snap, tree)
@@ -206,10 +206,13 @@ def save_checkpoint(directory: str, state: TrainState,
     wait_pending(directory)
     _manager(directory, refresh=False)
     s = int(jax.device_get(state.step)) if step is None else int(step)
-    # host snapshot NOW, on the caller's thread: (a) the donated train
-    # step may invalidate these device buffers one step later, (b) the
-    # caller's transfer_guard("allow") window must cover the only D2H
-    # this save performs — the background thread does pure host I/O
+    # snapshot NOW, on the caller's thread: the donated train step may
+    # invalidate these device buffers one step later. Replicated leaves
+    # D2H here (inside the caller's transfer_guard("allow") window);
+    # fsdp-sharded leaves stay on device as defensive copies and orbax
+    # serializes them per-addressable-shard on the flush thread (whose
+    # D2H is invisible to the main thread's strict transfer guard —
+    # guard state is thread-local)
     host_state = _host_snapshot(_keys_to_data(state))
     t0 = time.perf_counter()
     started = threading.Event()
@@ -401,13 +404,28 @@ def require_checkpoints(directory: str) -> None:
         f"no checkpoints under {directory!r} ({detail}){hint}")
 
 
+def _abstract_leaf(x: Any) -> Any:
+    """ShapeDtypeStruct for a template leaf, carrying the leaf's mesh
+    sharding when it has one: orbax then restores straight INTO that
+    layout — each host reads only its shards (the per-shard restore
+    path the fsdp axis needs; works equally for resharding a
+    replicated-era checkpoint onto an fsdp mesh and vice versa).
+    Host/numpy and single-device template leaves keep the historical
+    plain-abstract restore."""
+    sharding = getattr(x, "sharding", None)
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        return jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=sharding)
+    return ocp.utils.to_shape_dtype_struct(x)
+
+
 def restore_checkpoint(
     directory: str, template: TrainState, step: Optional[int] = None
 ) -> TrainState:
     """Restore a full TrainState; ``template`` supplies tree structure,
-    shapes, and shardings (create one with create_state). Typed PRNG-key
-    leaves in the template are restored dtype-preserving (re-wrapped from
-    their saved key data with the template's impl)."""
+    shapes, and shardings (create one with create_state; shard it with
+    parallel.layout.shard_state to land the restore sharded). Typed
+    PRNG-key leaves in the template are restored dtype-preserving
+    (re-wrapped from their saved key data with the template's impl)."""
     wait_pending(directory)
     mgr = _manager(directory)
     if step is None:
@@ -415,7 +433,7 @@ def restore_checkpoint(
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     data_template = _keys_to_data(template)
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, data_template)
+    abstract = jax.tree.map(_abstract_leaf, data_template)
     restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     return _data_to_keys(restored, template)
 
@@ -460,6 +478,16 @@ def restore_params_into(
     for key, new_leaf in flat_new.items():
         old = flat_old.get(key)
         if old is not None and tuple(old.shape) == tuple(new_leaf.shape):
+            # graft into the template leaf's RESOLVED sharding: on an
+            # fsdp mesh the fresh init is already in its storage layout
+            # (layout.shard_state), and a restored leaf — whatever mesh
+            # or era saved it — must land the same way, not as a
+            # host-local replicated copy that the first fenced step
+            # would then silently reshard
+            sharding = getattr(new_leaf, "sharding", None)
+            if (isinstance(sharding, jax.sharding.NamedSharding)
+                    and not getattr(old, "sharding", None) == sharding):
+                old = jax.device_put(old, sharding)
             merged[key] = old
         else:
             skipped.append(key)

@@ -8,6 +8,15 @@ is sharded over the mesh's 'data' axis, the state is replicated, and the
 SPMD partitioner inserts the gradient all-reduce over ICI (the TPU-native
 replacement for DataParallel's NCCL gather, SURVEY.md §2.7).
 
+On an fsdp mesh (parallel/layout.make_train_mesh(..., fsdp=...)) the
+state is additionally STORED sharded: params and Adam moments live
+split over the 'fsdp' axis between steps (per-leaf layout in
+layout.state_sharding), the step gathers them to replicated at entry
+and re-shards at exit — the fence pattern documented in docs/perf.md
+"Sharded state (fsdp)". Compute inside the fences is byte-for-byte the
+replicated program; what changes is the persistent per-device HBM
+(state at ~1/fsdp) and the checkpoint path (per-shard orbax I/O).
+
 BatchNorm note: under a sharded batch the normalizing statistics are
 GLOBAL across chips (XLA inserts the cross-chip mean) — i.e. sync-BN.
 The reference's DataParallel computes per-device stats; sync-BN is the
@@ -29,9 +38,10 @@ from dexiraft_tpu.parallel.layout import (
     LAYOUT,
     batch_input_sharding,
     replicated_sharding,
+    state_sharding,
 )
 from dexiraft_tpu.train.optimizer import training_schedule
-from dexiraft_tpu.train.state import TrainState, make_optimizer_from
+from dexiraft_tpu.train.state import TrainState, create_state, make_optimizer_from
 
 Batch = Dict[str, jax.Array]  # image1, image2, flow, valid [, edges1, edges2]
 
@@ -148,7 +158,21 @@ def make_train_step(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+    # fsdp fence shardings, filled in below when the mesh has the axis;
+    # None on every other path so the step body compiles unchanged
+    fence_repl = None
+
     def step(state: TrainState, batch: Batch):
+        if fence_repl is not None:
+            # ENTRY FENCE (fsdp): the state arrives in its storage
+            # layout (params/opt_state sharded over 'fsdp' per
+            # layout.state_sharding); gather it to replicated HERE so
+            # the partitioner never sees an fsdp-sharded tensor inside
+            # the model — GSPMD miscompiles feature-dim-partitioned
+            # convolutions on this backend (the conv-of-concat repro in
+            # tests/test_zzzfsdp.py), so fsdp is a storage axis only.
+            # Everything below computes exactly the replicated program.
+            state = jax.lax.with_sharding_constraint(state, fence_repl)
         rng, noise_rng, dropout_rng = jax.random.split(state.rng, 3)
         if tc.add_noise:
             k_stdv, k1, k2 = jax.random.split(noise_rng, 3)
@@ -221,6 +245,14 @@ def make_train_step(
         metrics = dict(metrics, loss=loss, lr=schedule(state.step),
                        state_finite=all_finite(params, batch_stats,
                                                opt_state))
+        if fence_repl is not None:
+            # EXIT FENCE (fsdp): pin the finished state replicated so
+            # sharding propagation from the sharded out_shardings below
+            # stops at this boundary — the re-shard back to storage
+            # layout is a pure slice at the jit output, never a
+            # different partitioning of the compute above.
+            new_state = jax.lax.with_sharding_constraint(
+                new_state, fence_repl)
         return new_state, metrics
 
     if mesh is None:
@@ -234,10 +266,23 @@ def make_train_step(
     # the same helper the device prefetcher puts with, so prefetched
     # batches arrive already in this layout
     data = batch_input_sharding(mesh)
+    state_sh = repl
+    if LAYOUT.has_fsdp(mesh):
+        # fsdp mesh: pin the state's STORAGE layout per leaf — params
+        # and Adam moments sharded over 'fsdp' (layout.param_leaf_spec
+        # decides dim + divisibility fallback centrally), the rest
+        # replicated. The step body gathers at entry and re-pins at
+        # exit (fences above); in/out match, so donation still aliases
+        # shard-for-shard. The abstract eval_shape costs one host-side
+        # trace of create_state, only on fsdp meshes.
+        abstract = jax.eval_shape(
+            lambda: create_state(jax.random.PRNGKey(0), cfg, tc))
+        state_sh = state_sharding(mesh, abstract)
+        fence_repl = jax.tree.map(lambda _: repl, abstract)
     return jax.jit(
         step,
-        in_shardings=(repl, data),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data),
+        out_shardings=(state_sh, repl),
         donate_argnums=0,
     )
 

@@ -30,6 +30,22 @@ from dexiraft_tpu.config import VARIANTS, RAFTConfig, TrainConfig
 _VAL_ITERS = {"chairs": 24, "sintel": 32, "kitti": 24, "hd1k": 24}
 
 
+def fsdp_arg(value: str):
+    """argparse type= for --fsdp: 'auto' or a positive integer, refused
+    at parse time with usage text (not a raw int() traceback after the
+    dataset/import setup has already run). Shared by train_bench."""
+    if value == "auto":
+        return value
+    try:
+        n = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or an integer, got {value!r}")
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"expected >= 1, got {n}")
+    return n
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dexiraft-train")
     p.add_argument("--name", default=None,
@@ -83,6 +99,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient accumulation: batch_size = accum * "
                         "microbatch; the microbatches run as a lax.scan "
                         "inside the ONE jitted step")
+    p.add_argument("--fsdp", default=None, type=fsdp_arg,
+                   help="shard params + optimizer state over the mesh's "
+                        "fsdp axis: 'auto' grows the axis over every "
+                        "device left after data-parallelism takes the "
+                        "largest batch divisor (host-count-aware), an "
+                        "integer forces that many ways; default/1 keeps "
+                        "the replicated layout. Storage-only sharding "
+                        "(docs/perf.md): per-device state HBM drops "
+                        "~fsdp-fold, checkpoints flush per shard, the "
+                        "step gathers at entry so the math is the "
+                        "replicated step's")
     p.add_argument("--prefetch_depth", type=int, default=2,
                    help="device-side prefetch depth (batches device_put "
                         "ahead with the step's input shardings while the "
@@ -294,6 +321,7 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     from dexiraft_tpu.data.datasets import fetch_dataset
     from dexiraft_tpu.data.loader import Loader
     from dexiraft_tpu.data.prefetch import prefetch_to_device
+    from dexiraft_tpu.parallel import layout
     from dexiraft_tpu.parallel.layout import make_train_mesh
     from dexiraft_tpu.resilience import (
         Coordinator,
@@ -314,20 +342,39 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     np.random.seed(tc.seed)
     ckpt_dir = osp.join(args.output, tc.name)
 
-    if args.compile_cache or args.compile_cache_dir:
-        from dexiraft_tpu.profiling import enable_persistent_cache
-
-        print(f"[cache] persistent XLA compile cache: "
-              f"{enable_persistent_cache(args.compile_cache_dir)}")
-
     # mesh policy lives in the canonical layout (parallel/layout.py):
-    # 1-D data mesh over the largest device count dividing the batch
-    mesh = make_train_mesh(tc.batch_size)
-    if mesh.size < len(jax.devices()):
-        print(f"[mesh] batch {tc.batch_size} not divisible by "
-              f"{len(jax.devices())} devices; using {mesh.size}")
+    # data over the largest device count dividing the batch, plus an
+    # fsdp axis over the leftover devices when --fsdp asks for one
+    # (already 'auto'/int — the fsdp_arg parse-time type)
+    mesh = make_train_mesh(tc.batch_size, fsdp=args.fsdp)
+    if mesh.size < len(jax.devices()) or len(mesh.shape) > 1:
+        print(f"[mesh] {dict(mesh.shape)} over {len(jax.devices())} "
+              f"devices (batch {tc.batch_size})")
+
+    if args.compile_cache or args.compile_cache_dir:
+        if layout.LAYOUT.has_fsdp(mesh):
+            # a persistent-cache HIT of the donated fsdp step crashes
+            # this backend (deserialized executable segfault, jax
+            # 0.4.37 CPU — bisected in the fsdp PR; cold writes are
+            # fine, which makes the crash land on the SECOND launch);
+            # refuse loudly rather than let a relaunch die mid-warmup.
+            # docs/perf.md "Sharded state (fsdp)" has the story.
+            print("[cache] persistent compile cache DISABLED: "
+                  "cache-hit fsdp executables crash this backend "
+                  "(docs/perf.md 'Sharded state (fsdp)')")
+        else:
+            from dexiraft_tpu.profiling import enable_persistent_cache
+
+            print(f"[cache] persistent XLA compile cache: "
+                  f"{enable_persistent_cache(args.compile_cache_dir)}")
     state = create_state(jax.random.PRNGKey(tc.seed), cfg, tc)
     print(f"Parameter Count: {param_count(state.params)}")
+    fsdp_live = layout.LAYOUT.has_fsdp(mesh)
+    if fsdp_live:
+        # storage layout from step one: params/opt_state land sharded,
+        # so every restore below (resume, rollback, partial) restores
+        # per shard into the template's resolved shardings
+        state = layout.shard_state(state, mesh)
 
     # last checkpoint that belongs to THIS trajectory — the only valid
     # rollback target. A stale dir from a previous experiment must never
@@ -484,8 +531,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     step_fn = make_train_step(cfg, tc, mesh=mesh)
     logger = Logger(tc.sum_freq, log_dir=osp.join(args.log_dir, tc.name),
                     model_iters=tc.iters, pipeline_stats=loader.stats)
-    validate = _make_validators(cfg, tc.validation,
-                                lambda: state.variables)
+    # fsdp: validation's eval step compiles WITHOUT the train step's
+    # gather fences, so it must never see fsdp-sharded params — gather
+    # explicitly (sanctioned host window; layout.gather_state is a
+    # no-op on replicated leaves / non-fsdp meshes)
+    validate = _make_validators(
+        cfg, tc.validation,
+        (lambda: layout.gather_state(state.variables, mesh)) if fsdp_live
+        else (lambda: state.variables))
 
     prof_start, prof_stop = args.profile_steps or (-1, -1)
     prof_dir = osp.join(args.log_dir, tc.name, "profile")
@@ -547,8 +600,13 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         block=True (emergency/final save) commits before returning."""
         nonlocal last_saved
         # checkpoint I/O is a sanctioned host sync — exempt from the
-        # strict transfer guard
-        with jax.transfer_guard("allow"):
+        # strict transfer guard, and from the recompile sentinel: the
+        # fsdp per-shard snapshot compiles a one-time device copy per
+        # leaf shape (train/checkpoint._host_snapshot), which the
+        # end-of-run strict verdict must not read as steady-state drift
+        ctx = (watch.sanctioned() if watch is not None
+               else contextlib.nullcontext())
+        with ctx, jax.transfer_guard("allow"):
             note_flush(ckpt.wait_pending(ckpt_dir))
             # GC BEFORE the new handoff: delete_step barriers on any
             # in-flight flush, so GC after would serialize save+GC and

@@ -12,10 +12,14 @@ Wired into the tier-1 verify command right after lint_gate.py
 (ROADMAP.md).
 
 Usage:
-  python scripts/shard_audit.py                  # gate: diff vs golden
-  python scripts/shard_audit.py --write-golden   # regenerate (review the
-                                                 # diff in the PR!)
+  python scripts/shard_audit.py                  # gate: diff vs BOTH
+                                                 # goldens (incl. the
+                                                 # fsdp leg)
+  python scripts/shard_audit.py --write-golden   # regenerate both
+                                                 # (review the diff in
+                                                 # the PR!)
   python scripts/shard_audit.py --steps serve    # partial (faster) audit
+  python scripts/shard_audit.py --steps train_fsdp  # fsdp leg only
   python scripts/shard_audit.py --json           # dump the full report
 
 Exit codes: 0 clean, 1 drift or a flagged replicated group.
@@ -43,14 +47,18 @@ sys.path.insert(0, REPO)
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("shard_audit")
-    ap.add_argument("--steps", default="train,eval,serve",
-                    help="comma-separated subset of train,eval,serve "
-                         "(partial runs diff only their sections)")
+    ap.add_argument("--steps", default="train,eval,serve,train_fsdp",
+                    help="comma-separated subset of train,eval,serve,"
+                         "train_fsdp (partial runs diff only their "
+                         "sections; train_fsdp diffs the fsdp golden)")
     ap.add_argument("--golden", default=None,
                     help="golden path (default: "
                          "dexiraft_tpu/analysis/layout_golden.json)")
+    ap.add_argument("--fsdp-golden", default=None,
+                    help="fsdp golden path (default: dexiraft_tpu/"
+                         "analysis/layout_golden_fsdp.json)")
     ap.add_argument("--write-golden", action="store_true",
-                    help="regenerate the golden from this run (always "
+                    help="regenerate BOTH goldens from this run (always "
                          "audits ALL steps)")
     ap.add_argument("--threshold-mb", type=float, default=None,
                     help="replicated-array size tripwire (default 64)")
@@ -65,21 +73,41 @@ def main(argv=None) -> int:
     from dexiraft_tpu.analysis import shardaudit
 
     golden_path = args.golden or shardaudit.GOLDEN_PATH
+    fsdp_golden_path = args.fsdp_golden or shardaudit.FSDP_GOLDEN_PATH
     threshold = (args.threshold_mb if args.threshold_mb is not None
                  else shardaudit.DEFAULT_THRESHOLD_MB)
     steps = [s for s in args.steps.split(",") if s]
-    unknown = set(steps) - set(shardaudit.STEP_AUDITS)
+    known = set(shardaudit.STEP_AUDITS) | set(shardaudit.FSDP_STEP_AUDITS)
+    unknown = set(steps) - known
     if unknown:
         ap.error(f"unknown steps {sorted(unknown)}; "
-                 f"choose from {sorted(shardaudit.STEP_AUDITS)}")
+                 f"choose from {sorted(known)}")
     if args.write_golden:
-        steps = sorted(shardaudit.STEP_AUDITS)
+        steps = sorted(known)
+    main_steps = [s for s in steps if s in shardaudit.STEP_AUDITS]
+    fsdp_steps = [s for s in steps if s in shardaudit.FSDP_STEP_AUDITS]
 
-    report = shardaudit.run_audit(steps, threshold_mb=threshold)
+    # (report, golden path, label) per golden file in play — the fsdp
+    # leg diffs its own golden so the data x seq one never drifts when
+    # only the fsdp layout changes (and vice versa)
+    legs = []
+    if main_steps or args.write_golden:
+        legs.append((shardaudit.run_audit(main_steps,
+                                          threshold_mb=threshold),
+                     golden_path, "main"))
+    if fsdp_steps:
+        legs.append((shardaudit.run_audit_fsdp(fsdp_steps,
+                                               threshold_mb=threshold),
+                     fsdp_golden_path, "fsdp"))
+
     if args.json:
-        print(json.dumps(report, indent=1, sort_keys=True))
+        print(json.dumps({label: rep for rep, _, label in legs},
+                         indent=1, sort_keys=True))
 
-    flagged = shardaudit.flagged_groups(report)
+    flagged = []
+    for rep, _, label in legs:
+        for line in shardaudit.flagged_groups(rep):
+            flagged.append(f"[{label}] {line}")
     for line in flagged:
         print(f"shard audit: FLAGGED {line}")
 
@@ -88,25 +116,30 @@ def main(argv=None) -> int:
             print("shard audit: refusing to write a golden with flagged "
                   "replicated groups — fix the layout first")
             return 1
-        shardaudit.write_golden(report, golden_path)
-        print(f"shard audit: wrote {golden_path} "
-              f"(hash {shardaudit.golden_hash(golden_path)[:12]})")
+        for rep, path, label in legs:
+            shardaudit.write_golden(rep, path)
+            print(f"shard audit: wrote {path} "
+                  f"(hash {shardaudit.golden_hash(path)[:12]})")
         return 0
 
-    try:
-        golden = shardaudit.load_golden(golden_path)
-    except FileNotFoundError:
-        print(f"shard audit: no golden at {golden_path} — bootstrap with "
-              f"--write-golden")
-        return 1
-    drift = shardaudit.diff_golden(report, golden)
+    drift = []
+    hashes = []
+    for rep, path, label in legs:
+        try:
+            golden = shardaudit.load_golden(path)
+        except FileNotFoundError:
+            print(f"shard audit: no golden at {path} — bootstrap with "
+                  f"--write-golden")
+            return 1
+        drift += [f"[{label}] {d}"
+                  for d in shardaudit.diff_golden(rep, golden)]
+        hashes.append(shardaudit.golden_hash(path)[:12])
     for line in drift:
         print(f"shard audit: DRIFT {line}")
     ok = not drift and not flagged
     print(f"shard audit: {len(steps)} step(s) "
           f"({','.join(steps)}), {len(drift)} drift line(s), "
-          f"{len(flagged)} flagged group(s), golden "
-          f"{shardaudit.golden_hash(golden_path)[:12]}"
+          f"{len(flagged)} flagged group(s), golden {'+'.join(hashes)}"
           f"{'' if ok else ' — FAIL'}")
     return 0 if ok else 1
 

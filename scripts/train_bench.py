@@ -22,11 +22,29 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import os.path as osp
 import sys
 import time
 
 sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+# --host_devices N must take effect BEFORE jax's backend initializes
+# (same dance as scripts/shard_audit.py): it forces N virtual host
+# devices so the fsdp A/B runs on a laptop/CI box without a TPU.
+for _i, _arg in enumerate(sys.argv):
+    if _arg == "--host_devices" and _i + 1 < len(sys.argv):
+        _n = sys.argv[_i + 1]
+    elif _arg.startswith("--host_devices="):
+        _n = _arg.split("=", 1)[1]
+    else:
+        continue
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if _n.isdigit() and \
+            "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_n}")
+    break  # a malformed value falls through to argparse's own refusal
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +52,8 @@ import numpy as np
 
 
 def main():
+    from dexiraft_tpu.train_cli import fsdp_arg
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--variant", default="v5")
     ap.add_argument("--batch", type=int, default=6,
@@ -77,6 +97,19 @@ def main():
                     help="force the CPU backend (shakeout while the "
                          "tunnel is down; config.update beats the "
                          "axon site-hook pin)")
+    ap.add_argument("--fsdp", default=None, type=fsdp_arg,
+                    help="shard params + optimizer state over the "
+                         "mesh's fsdp axis ('auto' or an integer; see "
+                         "train --fsdp). Enables the mesh path: the "
+                         "step runs with pinned state shardings and "
+                         "the record's state_bytes_per_device shows "
+                         "the storage win; 1 = replicated mesh "
+                         "baseline for the A/B")
+    ap.add_argument("--host_devices", type=int, default=None,
+                    help="force N virtual host devices (CPU) so the "
+                         "fsdp A/B runs without a TPU; must be the "
+                         "first jax-visible setting, handled before "
+                         "import")
     args = ap.parse_args()
     if args.fused_update and args.corr_impl not in ("pallas", "flash"):
         ap.error("--fused_update requires --corr_impl flash or pallas")
@@ -90,8 +123,29 @@ def main():
     from dexiraft_tpu.train.state import create_state
     from dexiraft_tpu.train.step import make_train_step
 
+    # --fsdp enables the mesh path: state stored sharded between steps
+    # (parallel/layout.state_sharding), gathered inside the step's
+    # fences; --fsdp 1 is the replicated-mesh baseline of the A/B
+    mesh = None
+    fsdp_live = False
+    if args.fsdp is not None:
+        from dexiraft_tpu.parallel.layout import LAYOUT, make_train_mesh
+
+        mesh = make_train_mesh(args.batch, fsdp=args.fsdp)
+        fsdp_live = LAYOUT.has_fsdp(mesh)
+        print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
+
     cache_dir = None
-    if not args.no_compile_cache:
+    if not args.no_compile_cache and fsdp_live:
+        # a DESERIALIZED (persistent-cache-hit) executable of the
+        # donated fsdp step segfaults this backend on its second call
+        # (jax 0.4.37 CPU; bisected in the fsdp PR — cold cache writes
+        # and uncached compiles are clean, any warm hit crashes), so
+        # fsdp benches run uncached until upstream fixes the cache path
+        print("fsdp: persistent compile cache disabled (cache-hit fsdp "
+              "executables crash this backend; see docs/perf.md "
+              "'Sharded state (fsdp)')", file=sys.stderr)
+    elif not args.no_compile_cache:
         cache_dir = enable_persistent_cache(args.compile_cache_dir)
         print(f"compile cache: {cache_dir}", file=sys.stderr)
 
@@ -114,7 +168,11 @@ def main():
 
     t0 = time.perf_counter()
     state = create_state(jax.random.PRNGKey(0), cfg, tc)
-    step_fn = make_train_step(cfg, tc)
+    if mesh is not None:
+        from dexiraft_tpu.parallel.layout import shard_state
+
+        state = shard_state(state, mesh)
+    step_fn = make_train_step(cfg, tc, mesh=mesh)
     init_s = time.perf_counter() - t0
     print(f"init {init_s:.1f}s", file=sys.stderr)
 
@@ -173,7 +231,7 @@ def main():
             pass
         return
 
-    pf = prefetch_to_device(host_batches(), depth=args.prefetch)
+    pf = prefetch_to_device(host_batches(), mesh, depth=args.prefetch)
 
     # split the one-time cost into its phases so the persistent cache's
     # effect is legible: tracing/lowering is Python (never cached), the
@@ -184,12 +242,13 @@ def main():
     # TWICE, so --no_compile_cache times the combined first call instead
     first = next(pf)
     lower_s = None
+    compiled = None
     if cache_dir is not None:
         t0 = time.perf_counter()
         lowered = step_fn.lower(state, first)
         lower_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        lowered.compile()
+        compiled = lowered.compile()
         compile_s = time.perf_counter() - t0
         print(f"trace+lower {lower_s:.1f}s, backend compile "
               f"{compile_s:.1f}s (a second identical launch collapses "
@@ -203,7 +262,7 @@ def main():
     # through the relay tunnel; jaxlint JL007)
     float(jax.device_get(metrics["loss"]))
     first_step_s = time.perf_counter() - t0
-    if cache_dir is None:
+    if cache_dir is None or compiled is None:
         compile_s = first_step_s  # compile + one step, combined
     print(f"first step (compile included if uncached) {first_step_s:.1f}s",
           file=sys.stderr)
@@ -246,6 +305,38 @@ def main():
     except Exception as e:
         print(f"cost_analysis unavailable: {e}", file=sys.stderr)
 
+    # persistent state footprint per device — params + opt_state as the
+    # COMPILED step holds them between steps (its input shardings; the
+    # live arrays' own shardings when the AOT executable was skipped).
+    # This is the fsdp storage win in the record schema: on an fsdp=N
+    # mesh it drops toward 1/N of the replicated figure, and it is
+    # exact, not sampled — shard_shape of every leaf.
+    def state_bytes_per_device() -> int:
+        from jax.tree_util import tree_flatten_with_path
+
+        sh_tree = None
+        if compiled is not None:
+            try:
+                sh_tree = compiled.input_shardings[0][0]
+            except Exception:
+                sh_tree = None
+        flat_state = tree_flatten_with_path(state)[0]
+        flat_sh = (tree_flatten_with_path(sh_tree)[0]
+                   if sh_tree is not None else None)
+        total = 0
+        for i, (path, leaf) in enumerate(flat_state):
+            if getattr(path[0], "name", None) not in ("params",
+                                                      "opt_state"):
+                continue
+            shape = np.shape(leaf)
+            sharding = (flat_sh[i][1] if flat_sh is not None
+                        else getattr(leaf, "sharding", None))
+            if sharding is not None:
+                shape = sharding.shard_shape(tuple(shape))
+            total += (int(np.prod(shape, dtype=np.int64))
+                      * np.dtype(leaf.dtype).itemsize)
+        return total
+
     report = ThroughputReport(batch=args.batch, height=h, width=w,
                               iters=args.iters)
     record = {
@@ -266,6 +357,8 @@ def main():
         "prefetch_stall_ms_per_step": round(
             pf.stats.stall_per_batch_s * 1e3, 3),
         "prefetch_stalled_steps": pf.stats.stalls,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "state_bytes_per_device": state_bytes_per_device(),
         **report.fields(dt, flops, peak),
     }
     if flops and peak is None:

@@ -427,6 +427,24 @@ class TestGuards:
         watch.warn_if_drifted(file=buf2)  # once-only
         assert buf2.getvalue() == ""
 
+    def test_sanctioned_window_absorbs_only_its_compiles(self):
+        """The checkpoint-save sanction (train_cli.save_with_position):
+        compiles INSIDE the window — the fsdp snapshot's one-time
+        per-shape device copies — shift the baseline; compiles outside
+        still count as drift."""
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        watch = guards.RecompileWatch("fixture")
+        watch.mark_warm()
+        with watch.sanctioned():
+            jax.jit(lambda x: x / 7)(jnp.ones((13,)))  # planned: absorbed
+        assert watch.drift == 0
+        jax.jit(lambda x: x / 9)(jnp.ones((17,)))  # unplanned: counted
+        assert watch.drift >= 1
+
     def test_strict_mode_raises_on_post_warmup_compile(self):
         import jax
         import jax.numpy as jnp

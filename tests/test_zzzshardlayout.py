@@ -249,9 +249,10 @@ class TestSpecLayout:
         from dexiraft_tpu.parallel.layout import SpecLayout
 
         expected = {"replicated", "params", "opt_state", "fsdp_params",
-                    "batch", "batch_spatial", "carry", "corr_query_rows",
-                    "batch_for", "corr_volume", "corr_fmaps", "data_size",
-                    "has_seq"}
+                    "param_leaf_spec", "batch", "batch_spatial", "carry",
+                    "corr_query_rows", "batch_for", "corr_volume",
+                    "corr_fmaps", "data_size", "has_seq", "has_fsdp",
+                    "fsdp_size"}
         public = {n for n in dir(SpecLayout) if not n.startswith("_")
                   and callable(getattr(SpecLayout, n))}
         assert public == expected
@@ -293,10 +294,15 @@ class TestSpecLayout:
         assert mesh.DATA_AXIS == layout.LAYOUT.data_axis
 
     def test_replicated_ok_covers_state_groups(self):
+        """Since the fsdp axis went live, params/opt_state carry NO
+        replicated-by-design exemption — the size canary is armed on
+        them (tests/test_zzzfsdp.py exercises it); only the genuinely
+        global groups stay pinned."""
         from dexiraft_tpu.parallel.layout import REPLICATED_OK
 
-        for name in ("params", "opt_state", "batch_stats"):
-            assert name in REPLICATED_OK
+        assert "batch_stats" in REPLICATED_OK
+        assert "params" not in REPLICATED_OK
+        assert "opt_state" not in REPLICATED_OK
 
 
 # --------------------------------------------------------------------------
@@ -399,8 +405,9 @@ class TestGoldenDiff:
 
 class TestAuditCLI:
     """Exit-code wiring of scripts/shard_audit.py, with the expensive
-    compile stage monkeypatched to replay the shipped golden — the real
-    compiles run in the tier-1 verify command itself."""
+    compile stages (both legs — the fsdp one runs by default since the
+    axis went live) monkeypatched to replay the shipped goldens — the
+    real compiles run in the tier-1 verify command itself."""
 
     @staticmethod
     def _main():
@@ -410,8 +417,16 @@ class TestAuditCLI:
         spec.loader.exec_module(mod)
         return mod.main
 
+    @staticmethod
+    def _patch_fsdp(monkeypatch):
+        fsdp_golden = shardaudit.load_golden(shardaudit.FSDP_GOLDEN_PATH)
+        monkeypatch.setattr(
+            shardaudit, "run_audit_fsdp",
+            lambda steps, threshold_mb: copy.deepcopy(fsdp_golden))
+
     def test_clean_report_exits_zero(self, monkeypatch):
         main = self._main()
+        self._patch_fsdp(monkeypatch)
         monkeypatch.setattr(shardaudit, "run_audit",
                             lambda steps, threshold_mb: copy.deepcopy(
                                 _golden()))
@@ -419,6 +434,7 @@ class TestAuditCLI:
 
     def test_spec_drift_exits_nonzero(self, monkeypatch, capsys):
         main = self._main()
+        self._patch_fsdp(monkeypatch)
 
         def mutated(steps, threshold_mb):
             r = copy.deepcopy(_golden())
@@ -432,6 +448,7 @@ class TestAuditCLI:
 
     def test_flagged_replication_exits_nonzero(self, monkeypatch):
         main = self._main()
+        self._patch_fsdp(monkeypatch)
 
         def flagged(steps, threshold_mb):
             r = copy.deepcopy(_golden())
