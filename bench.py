@@ -36,7 +36,8 @@ ITERS = 32
 BENCH_RECORD_KEYS = frozenset({
     "metric", "value", "unit", "vs_baseline", "platform", "fallback",
     "baseline_kind", "baseline_iters_per_sec", "device_kind", "iters",
-    "corr_impl", "corr_dtype", "fused_update", "dexined_upconv",
+    "corr_impl", "corr_impl_resolved", "corr_dtype", "fused_update",
+    "dexined_upconv",
     "loop_only_iters_per_sec", "loop_only_vs_whole_forward_baseline",
     "allpairs_iters_per_sec", "local_corr_iters_per_sec",
     "pallas_corr_iters_per_sec", "flash_corr_iters_per_sec",
@@ -348,7 +349,7 @@ def main() -> None:
         platform = jax.devices()[0].platform
     import jax.numpy as jnp
 
-    from dexiraft_tpu.config import raft_v5
+    from dexiraft_tpu.config import raft_v5, resolve_corr_impl
     from dexiraft_tpu.models.raft import RAFT
 
     on_tpu = platform == "tpu"
@@ -687,6 +688,12 @@ def main() -> None:
         **mfu_fields,
         "iters": iters,
         "corr_impl": impl,
+        # what --corr_impl auto WOULD resolve to on this record's
+        # platform (config.resolve_corr_impl) — eval/serve print it but
+        # records never carried it, so cross-box A/Bs had to infer the
+        # production config from the platform field. Distinct from
+        # corr_impl: the sweep's WINNER vs the auto-resolution.
+        "corr_impl_resolved": resolve_corr_impl("auto", platform)[0],
         # the winning config's pyramid storage precision and fused-step
         # flag (ISSUE 8): together with corr_impl/dexined_upconv these
         # four keys fully name the headline configuration

@@ -95,6 +95,12 @@ class RecompileWatch:
         _ensure_listener()
         self._warm_at: Optional[int] = None
         self._warned = False
+        # open sanctioned() windows (possibly on OTHER threads): the
+        # compile counter is process-global, so a check() racing an
+        # in-progress expected compile would read it as drift before
+        # the window's exit shifts the baseline
+        self._slock = threading.Lock()
+        self._sanctioned_depth = 0
 
     def mark_warm(self) -> None:
         self._warm_at = compile_count()
@@ -111,6 +117,15 @@ class RecompileWatch:
         budget (defaults to the watch's own). The strict-mode teeth; the
         observe-only path uses :meth:`warn_if_drifted` instead."""
         budget = self.budget if budget is None else budget
+        with self._slock:
+            if self._sanctioned_depth > 0:
+                # a sanctioned window is open (engines share one watch
+                # across threads: a cold streaming bucket compiling in
+                # a handler thread must not fail the pair dispatcher's
+                # concurrent check, and vice versa) — its exit shifts
+                # the baseline past its compiles; the next check has
+                # teeth again
+                return
         if self.drift > budget:
             raise RecompileBudgetExceeded(
                 f"[guards] {self.label}: {self.drift} backend compile(s) "
@@ -127,13 +142,28 @@ class RecompileWatch:
         drift observed OUTSIDE the window still counts: a checkpoint
         save's one-time per-shape device copies (the fsdp per-shard
         snapshot) pass, a train-step retrace before or after does not.
-        No-op before ``mark_warm()``."""
+        No-op before ``mark_warm()``. Thread-aware: while any window is
+        open, concurrent :meth:`check`/:meth:`warn_if_drifted` calls
+        (the other engine's dispatch on its own thread) defer rather
+        than read the in-progress expected compile as drift.
+
+        Known blind spot, accepted: the compile counter is
+        process-GLOBAL, so another thread's genuine drift landing inside
+        an open window is absorbed with it (``mark_warm()`` has the same
+        property — it baselines past everything). Attribution would need
+        per-thread counts the jax.monitoring listener does not expose;
+        windows are short (cold-bucket compiles), and steady-state drift
+        recurs, so the next post-window check catches a real leak."""
         before = compile_count()
+        with self._slock:
+            self._sanctioned_depth += 1
         try:
             yield
         finally:
-            if self._warm_at is not None:
-                self._warm_at += compile_count() - before
+            with self._slock:
+                self._sanctioned_depth -= 1
+                if self._warm_at is not None:
+                    self._warm_at += compile_count() - before
 
     def warn_if_drifted(self, file=None) -> bool:
         """One-line, once-only warning when post-warmup compiles exist.
@@ -141,6 +171,9 @@ class RecompileWatch:
         Returns True if drift was (ever) reported — callers embedding
         this in a loop get the cadence for free.
         """
+        with self._slock:
+            if self._sanctioned_depth > 0:
+                return self._warned
         d = self.drift
         if d > 0 and not self._warned:
             self._warned = True

@@ -242,6 +242,82 @@ def audit_serve(mesh=None) -> Dict[str, Any]:
     return _audit_eval_step(mesh)
 
 
+def _serve_mesh_and_cfg():
+    """Shared setup for the split-step serve audits: the 1-D serve mesh
+    and the production eval/serve config (flash + fused — what
+    resolve_corr_impl("auto") picks on TPU), matching _audit_eval_step
+    so the split signatures are audited in the same configuration as
+    the monolithic one they compose into."""
+    from dexiraft_tpu.config import raft_v1
+    from dexiraft_tpu.parallel.layout import make_serve_mesh
+
+    return (make_serve_mesh(SERVE_MESH["data"]),
+            raft_v1(small=True, corr_impl="flash", fused_update=True))
+
+
+def audit_serve_encode(mesh=None) -> Dict[str, Any]:
+    """The streaming tier's per-frame encoder stage (PR 14: RAFT
+    mode="encode" via train.step.make_encode_step), compiled on the
+    serve mesh. The golden pins variables replicated, the frame batch
+    P('data', ...), and every feature-dict output leaf (fmap/ctx) batch-
+    sharded — the device-resident session carry stores these arrays
+    as-is, so a spec change here silently changes what N streams pin in
+    HBM. Golden regenerated for this audit's introduction (new section,
+    no pre-existing specs changed)."""
+    import numpy as np
+    import jax
+
+    from dexiraft_tpu.config import TrainConfig
+    from dexiraft_tpu.train.step import make_encode_step
+
+    default_mesh, cfg = _serve_mesh_and_cfg()
+    if mesh is None:
+        mesh = default_mesh
+    h, w = AUDIT_IMAGE
+    state = _audit_state(cfg, TrainConfig())
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    im = jax.ShapeDtypeStruct((AUDIT_BATCH, h, w, 3), np.float32)
+    step = make_encode_step(cfg, mesh=mesh)
+    sections = _compiled_sections(step, (variables, im, None))
+    return {"mesh": _mesh_dict(mesh), **sections}
+
+
+def audit_serve_refine(mesh=None) -> Dict[str, Any]:
+    """The streaming tier's refinement stage (RAFT mode="step" via
+    train.step.make_refine_step) on the serve mesh: feature dicts in,
+    (flow_low, flow_up) out, everything batch-sharded, variables
+    replicated. Feature avals come from eval_shape over the encode step
+    — the audit can never drift from the real carry shapes. Same Pallas
+    interpreter dance as _audit_eval_step (CPU backend; resolved
+    shardings are unaffected)."""
+    import numpy as np
+    import jax
+
+    from dexiraft_tpu.config import TrainConfig
+    from dexiraft_tpu.train.step import make_encode_step, make_refine_step
+
+    default_mesh, cfg = _serve_mesh_and_cfg()
+    if mesh is None:
+        mesh = default_mesh
+    h, w = AUDIT_IMAGE
+    state = _audit_state(cfg, TrainConfig())
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    im = jax.ShapeDtypeStruct((AUDIT_BATCH, h, w, 3), np.float32)
+    fi = jax.ShapeDtypeStruct((AUDIT_BATCH, h // 8, w // 8, 2), np.float32)
+    feats = jax.eval_shape(make_encode_step(cfg), variables, im)
+    prev = os.environ.get("DEXIRAFT_PALLAS_INTERPRET")
+    os.environ["DEXIRAFT_PALLAS_INTERPRET"] = "1"
+    try:
+        step = make_refine_step(cfg, iters=AUDIT_ITERS, mesh=mesh)
+        sections = _compiled_sections(step, (variables, feats, feats, fi))
+    finally:
+        if prev is None:
+            os.environ.pop("DEXIRAFT_PALLAS_INTERPRET", None)
+        else:
+            os.environ["DEXIRAFT_PALLAS_INTERPRET"] = prev
+    return {"mesh": _mesh_dict(mesh), **sections}
+
+
 def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB,
                     mesh=None) -> Dict[str, Any]:
     """Resolve the layout's declared array groups at the PRODUCTION
@@ -310,7 +386,14 @@ def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB,
 
 
 STEP_AUDITS = {"train": audit_train, "eval": audit_eval,
-               "serve": audit_serve}
+               "serve": audit_serve,
+               # the split-model streaming signatures (PR 14): the same
+               # param tree as `serve` compiled as separate encode /
+               # refine executables — the device-carry session store
+               # holds the encode outputs between frames, so their
+               # resolved shardings are part of the serving contract
+               "serve_encode": audit_serve_encode,
+               "serve_refine": audit_serve_refine}
 #: Steps audited against the SEPARATE fsdp golden (FSDP_GOLDEN_PATH).
 FSDP_STEP_AUDITS = {"train_fsdp": audit_train_fsdp}
 
@@ -327,7 +410,8 @@ def _report_header() -> Dict[str, Any]:
     }
 
 
-def run_audit(steps: Sequence[str] = ("train", "eval", "serve"),
+def run_audit(steps: Sequence[str] = ("train", "eval", "serve",
+                                      "serve_encode", "serve_refine"),
               threshold_mb: float = DEFAULT_THRESHOLD_MB) -> Dict[str, Any]:
     report: Dict[str, Any] = {
         **_report_header(),

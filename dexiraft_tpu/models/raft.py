@@ -152,15 +152,163 @@ def _upsample(flow: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
 
 
 class RAFT(nn.Module):
-    """Full model: encoders + correlation pyramids + scanned refinement."""
+    """Full model: encoders + correlation pyramids + scanned refinement.
+
+    Three entry modes share ONE param tree (checkpoints interchange):
+
+      mode="pair"    (default) the monolithic two-frame forward — the
+                     reference behavior, byte-identical to the
+                     pre-split implementation (both frames ride one
+                     batched encoder call).
+      mode="encode"  per-FRAME encoder stage: fnet + cnet (and the
+                     edge-stream efnet/ecnet twins) on a single frame,
+                     returning the feature dict a later refinement can
+                     consume. The streaming engine runs this ONCE per
+                     new frame and pulls the previous frame's features
+                     from the session carry — half the encoder FLOPs of
+                     chained pair calls.
+      mode="step"    refinement from two feature dicts (features1 is
+                     the EARLIER frame — its ctx seeds the GRU) —
+                     pyramid build + scanned update loop, same returns
+                     as mode="pair".
+
+    In test mode the split composition equals the monolithic call to
+    float tolerance: the only difference is batched-vs-per-frame
+    encoder calls, and every encoder norm is per-sample there (instance
+    norm; BatchNorm on running stats). Parity is pinned in
+    tests/test_zzvideo.py.
+    """
 
     cfg: RAFTConfig = RAFTConfig()
+
+    # ---- shared construction helpers (called inside the compact ctx) ----
+
+    def _encoders(self, dtype):
+        """The four encoder modules with their historical pinned names —
+        both encode paths MUST construct them identically or the param
+        tree forks between fused and split serving."""
+        cfg = self.cfg
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+        Encoder = SmallEncoder if cfg.small else BasicEncoder
+        enc_norm = "instance"
+        ctx_norm = "none" if cfg.small else "batch"
+        fnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype,
+                       name="fnet")
+        cnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype,
+                       name="cnet")
+        efnet = ecnet = None
+        if cfg.has_edge_stream:
+            if cfg.variant == "dual":
+                # v5: dedicated 7-channel edge encoders (core/raft.py:61-71)
+                efnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype,
+                                name="efnet")
+                ecnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype,
+                                name="ecnet")
+            else:
+                # v3: image and edge streams share fnet/cnet
+                # (core/raft_3.py:110-127)
+                efnet, ecnet = fnet, cnet
+        return fnet, cnet, efnet, ecnet
+
+    def _dexined(self, dtype):
+        # name pinned to the historical auto-name so the pair and
+        # per-frame paths bind the same frozen extractor params
+        return DexiNed(dtype=dtype, upconv=self.cfg.dexined_upconv,
+                       name="DexiNed_0")
+
+    def _encode_pair(self, image1, image2, edges1, edges2, train, bn_train,
+                     dtype):
+        """The monolithic encoder stage: both frames through ONE batched
+        call per encoder (better MXU utilization than two passes).
+        Returns the two per-frame feature dicts _refine consumes; only
+        frame 1 carries ctx (the GRU seeds from the earlier frame)."""
+        cfg = self.cfg
+        image1 = _normalize(image1.astype(jnp.float32))
+        image2 = _normalize(image2.astype(jnp.float32))
+
+        em1 = em2 = None
+        if cfg.embed_dexined:
+            # frozen edge extraction: raw logits, gradients stopped — the
+            # no_grad contract of core/raft.py:111-123; under
+            # mixed_precision the frozen extractor runs in bf16 like the
+            # encoders — the reference keeps it fp32 only because it sits
+            # outside the autocast region (docs/parity.md)
+            both = jnp.concatenate([image1, image2], axis=0)
+            maps = stack_edge_maps(self._dexined(dtype)(both, train=False))
+            maps = jax.lax.stop_gradient(maps.astype(jnp.float32))
+            em1, em2 = jnp.split(maps, 2, axis=0)
+        elif cfg.variant in ("early", "separate"):
+            if edges1 is None or edges2 is None:
+                raise ValueError(
+                    f"variant {cfg.variant!r} without embed_dexined requires "
+                    "data-supplied edges1/edges2"
+                )
+            em1 = _normalize(edges1.astype(jnp.float32))
+            em2 = _normalize(edges2.astype(jnp.float32))
+
+        if cfg.variant == "early":
+            image1 = jnp.concatenate([image1, em1], axis=-1)
+            image2 = jnp.concatenate([image2, em2], axis=-1)
+            em1 = em2 = None
+
+        fnet, cnet, efnet, ecnet = self._encoders(dtype)
+        fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
+                            train=train, bn_train=bn_train)
+        f1: Dict[str, Any] = {"fmap": fmap1.astype(jnp.float32),
+                              "ctx": cnet(image1.astype(dtype), train=train,
+                                          bn_train=bn_train)}
+        f2: Dict[str, Any] = {"fmap": fmap2.astype(jnp.float32)}
+        if cfg.has_edge_stream:
+            fem1, fem2 = efnet((em1.astype(dtype), em2.astype(dtype)),
+                               train=train, bn_train=bn_train)
+            f1["efmap"] = fem1.astype(jnp.float32)
+            f2["efmap"] = fem2.astype(jnp.float32)
+            f1["ectx"] = ecnet(em1.astype(dtype), train=train,
+                               bn_train=bn_train)
+        return f1, f2
+
+    def _encode_frame(self, image, edges, train, bn_train, dtype):
+        """Per-frame encoder stage (mode="encode"): everything a frame
+        contributes to ANY pair it joins — fmap (as frame 1 or 2) AND
+        ctx (consumed only when it is the earlier frame). Computing ctx
+        unconditionally is what makes the streaming carry work: frame t
+        was frame 2 of pair (t-1, t) and becomes frame 1 of (t, t+1)
+        without re-encoding."""
+        cfg = self.cfg
+        image = _normalize(image.astype(jnp.float32))
+        em = None
+        if cfg.embed_dexined:
+            maps = stack_edge_maps(self._dexined(dtype)(image, train=False))
+            em = jax.lax.stop_gradient(maps.astype(jnp.float32))
+        elif cfg.variant in ("early", "separate"):
+            if edges is None:
+                raise ValueError(
+                    f"variant {cfg.variant!r} without embed_dexined requires "
+                    "a data-supplied edge frame in mode='encode'")
+            em = _normalize(edges.astype(jnp.float32))
+        if cfg.variant == "early":
+            image = jnp.concatenate([image, em], axis=-1)
+            em = None
+
+        fnet, cnet, efnet, ecnet = self._encoders(dtype)
+        out: Dict[str, Any] = {
+            "fmap": fnet(image.astype(dtype), train=train,
+                         bn_train=bn_train).astype(jnp.float32),
+            "ctx": cnet(image.astype(dtype), train=train,
+                        bn_train=bn_train),
+        }
+        if cfg.has_edge_stream:
+            out["efmap"] = efnet(em.astype(dtype), train=train,
+                                 bn_train=bn_train).astype(jnp.float32)
+            out["ectx"] = ecnet(em.astype(dtype), train=train,
+                                bn_train=bn_train)
+        return out
 
     @nn.compact
     def __call__(
         self,
-        image1: jax.Array,
-        image2: jax.Array,
+        image1: Optional[jax.Array],
+        image2: Optional[jax.Array] = None,
         edges1: Optional[jax.Array] = None,
         edges2: Optional[jax.Array] = None,
         iters: int = 12,
@@ -168,11 +316,19 @@ class RAFT(nn.Module):
         train: bool = False,
         freeze_bn: bool = False,
         test_mode: bool = False,
+        mode: str = "pair",
+        features1: Optional[Dict[str, Any]] = None,
+        features2: Optional[Dict[str, Any]] = None,
     ):
         """Estimate flow between two (B, H, W, 3) [0,255] frames.
 
         edges1/edges2: (B, H, W, 3) edge images for the v2/v3 variants
         (data-supplied edge contract); ignored when embed_dexined=True.
+
+        mode="encode" consumes only (image1 [, edges1]) and returns the
+        per-frame feature dict; mode="step" consumes features1/features2
+        (dicts from mode="encode" or the streaming carry) and ignores
+        the images. See the class docstring.
 
         Returns stacked per-iteration upsampled flows (iters, B, H, W, 2),
         or (flow_low, flow_up) in test_mode (core/raft.py:194-197).
@@ -194,46 +350,33 @@ class RAFT(nn.Module):
                 "stream consumes DexiNed's 7 logit maps; use raft_v5())"
             )
         dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
-
-        image1 = _normalize(image1.astype(jnp.float32))
-        image2 = _normalize(image2.astype(jnp.float32))
-
-        em1 = em2 = None
-        if cfg.embed_dexined:
-            # frozen edge extraction: raw logits, gradients stopped — the
-            # no_grad contract of core/raft.py:111-123. Both frames go
-            # through ONE batched call (better MXU utilization than two
-            # passes), and under mixed_precision the frozen extractor runs
-            # in bf16 like the encoders — the reference keeps it fp32 only
-            # because it sits outside the autocast region (docs/parity.md)
-            dexined = DexiNed(dtype=dtype, upconv=cfg.dexined_upconv)
-            both = jnp.concatenate([image1, image2], axis=0)
-            maps = stack_edge_maps(dexined(both, train=False))
-            maps = jax.lax.stop_gradient(maps.astype(jnp.float32))
-            em1, em2 = jnp.split(maps, 2, axis=0)
-        elif cfg.variant in ("early", "separate"):
-            if edges1 is None or edges2 is None:
-                raise ValueError(
-                    f"variant {cfg.variant!r} without embed_dexined requires "
-                    "data-supplied edges1/edges2"
-                )
-            em1 = _normalize(edges1.astype(jnp.float32))
-            em2 = _normalize(edges2.astype(jnp.float32))
-
-        if cfg.variant == "early":
-            image1 = jnp.concatenate([image1, em1], axis=-1)
-            image2 = jnp.concatenate([image2, em2], axis=-1)
-            em1 = em2 = None
-
-        hdim, cdim = cfg.hidden_dim, cfg.context_dim
-        Encoder = SmallEncoder if cfg.small else BasicEncoder
-        enc_norm = "instance"
-        ctx_norm = "none" if cfg.small else "batch"
         # freeze_bn: post-chairs stages run BN on running stats (train.py:149-150)
         bn_train = train and not freeze_bn
 
-        fnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype, name="fnet")
-        cnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype, name="cnet")
+        if mode == "encode":
+            return self._encode_frame(image1, edges1, train, bn_train, dtype)
+        if mode == "step":
+            if features1 is None or features2 is None:
+                raise ValueError(
+                    "mode='step' needs features1 AND features2 (per-frame "
+                    "dicts from mode='encode'; features1 is the EARLIER "
+                    "frame)")
+        elif mode == "pair":
+            if image1 is None or image2 is None:
+                # images became Optional for the split modes; fail the
+                # monolithic path loudly instead of a NoneType
+                # AttributeError deep inside _normalize
+                raise ValueError(
+                    "mode='pair' needs image1 AND image2 (two (B, H, W, "
+                    "3) frames; mode='encode' takes one, mode='step' "
+                    "takes feature dicts)")
+            features1, features2 = self._encode_pair(
+                image1, image2, edges1, edges2, train, bn_train, dtype)
+        else:
+            raise ValueError(f"unknown mode {mode!r}; expected "
+                             "'pair' | 'encode' | 'step'")
+
+        hdim = cfg.hidden_dim
 
         def build_pyr(f1, f2):
             # plugin seam (BASELINE.json): materialized MXU volume vs
@@ -249,11 +392,8 @@ class RAFT(nn.Module):
                                     kernel=("xla" if cfg.corr_impl == "local"
                                             else cfg.corr_impl))
 
-        fmap1, fmap2 = fnet((image1.astype(dtype), image2.astype(dtype)),
-                            train=train, bn_train=bn_train)
-        fmap1, fmap2 = fmap1.astype(jnp.float32), fmap2.astype(jnp.float32)
-
-        ctx = cnet(image1.astype(dtype), train=train, bn_train=bn_train)
+        fmap1, fmap2 = features1["fmap"], features2["fmap"]
+        ctx = features1["ctx"]
         net = jnp.tanh(ctx[..., :hdim])
         inp = nn.relu(ctx[..., hdim:])
 
@@ -264,17 +404,8 @@ class RAFT(nn.Module):
             coords1 = coords1 + flow_init
 
         if cfg.has_edge_stream:
-            if cfg.variant == "dual":
-                # v5: dedicated 7-channel edge encoders (core/raft.py:61-71)
-                efnet = Encoder(cfg.fnet_dim, enc_norm, cfg.dropout, dtype, name="efnet")
-                ecnet = Encoder(hdim + cdim, ctx_norm, cfg.dropout, dtype, name="ecnet")
-            else:
-                # v3: image and edge streams share fnet/cnet (core/raft_3.py:110-127)
-                efnet, ecnet = fnet, cnet
-            fem1, fem2 = efnet((em1.astype(dtype), em2.astype(dtype)),
-                               train=train, bn_train=bn_train)
-            fem1, fem2 = fem1.astype(jnp.float32), fem2.astype(jnp.float32)
-            ectx = ecnet(em1.astype(dtype), train=train, bn_train=bn_train)
+            fem1, fem2 = features1["efmap"], features2["efmap"]
+            ectx = features1["ectx"]
             # both streams share one batch axis: one pyramid build, one
             # lookup and one update-block call per iteration (RAFTStep)
             pyr = build_pyr(jnp.concatenate([fmap1, fem1], 0),

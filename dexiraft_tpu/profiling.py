@@ -126,6 +126,13 @@ class ServeStats:
         self.fetch_s = 0.0
         self.fetches = 0
         self.peak_inflight = 0
+        # warm-start carry transfer accounting (the PR 6 round-trip the
+        # device-resident handoff removes): H2D = host flow_init rows
+        # ridden up with a dispatch, D2H = flow_low bytes fetched to
+        # host for the carry. Both stay 0 on the device-carry path —
+        # scripts/video_bench.py pins the before/after.
+        self.carry_h2d_bytes = 0
+        self.carry_d2h_bytes = 0
         self.batch_latency_s: "collections.deque" = collections.deque(
             maxlen=self.maxlen)
 

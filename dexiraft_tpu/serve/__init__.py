@@ -1,13 +1,16 @@
 """Serving subsystem: throughput-mode inference engine (ISSUE 3), the
 persistent flow service around it (ISSUE 6) — SLO-aware request
-scheduling, session warm-start affinity, the stdlib HTTP tier — and
-the fleet router over N replicas (ISSUE 11): health-checked circuit
-breakers, consistent-hash session affinity, zero-drop failover.
+scheduling, session warm-start affinity, the stdlib HTTP tier — the
+fleet router over N replicas (ISSUE 11): health-checked circuit
+breakers, consistent-hash session affinity, zero-drop failover — and
+the split-encoder streaming tier (ISSUE 14): per-frame encode with
+cross-frame feature reuse over a device-resident, byte-budgeted
+session carry (POST /v1/flow/stream).
 
-Import layering: buckets/engine/scheduler/sessions import no jax at
-module level (unit-testable with a numpy stub eval_fn); server pulls
-them together; router imports no jax at all (pure control plane);
-serve_cli owns the jax-heavy restore/step construction.
+Import layering: buckets/engine/scheduler/sessions/video import no jax
+at module level (unit-testable with numpy stub fns); server pulls them
+together; router imports no jax at all (pure control plane); serve_cli
+owns the jax-heavy restore/step construction.
 """
 
 from dexiraft_tpu.serve.buckets import BucketRegistry, bucket_shape
@@ -18,7 +21,8 @@ from dexiraft_tpu.serve.router import (HashRing, NoHealthyReplica,
 from dexiraft_tpu.serve.scheduler import (QueueFull, Scheduler,
                                           SchedulerClosed, SchedulerStats)
 from dexiraft_tpu.serve.server import FlowService
-from dexiraft_tpu.serve.sessions import SessionStore
+from dexiraft_tpu.serve.sessions import DeviceSessionStore, SessionStore
+from dexiraft_tpu.serve.video import ChunkResult, VideoEngine
 
 __all__ = [
     "FlowService",
@@ -38,4 +42,7 @@ __all__ = [
     "SchedulerClosed",
     "SchedulerStats",
     "SessionStore",
+    "DeviceSessionStore",
+    "VideoEngine",
+    "ChunkResult",
 ]

@@ -37,6 +37,7 @@ and cold items without a second executable.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, List, NamedTuple, Optional, Tuple
@@ -70,6 +71,16 @@ class ServeConfig:
     # already-compiled bucket signature RAISES RecompileBudgetExceeded
     # instead of the default one-line drift warning
     strict: bool = False
+    # device-resident warm-start carry: per-item flow_init may be a jax
+    # DEVICE array (the session store's splatted carry, never fetched to
+    # host) — the engine assembles the batch's flow_init ON DEVICE (a
+    # jitted row stack over cached zero rows) and keeps each Result's
+    # flow_low as a device row instead of fetching it, so the carry
+    # path moves ZERO host<->device bytes per frame. flow_up is still
+    # fetched (it IS the response). Off (default): the PR 6 host-numpy
+    # carry semantics, kept for multi-worker pools and the data-parallel
+    # mesh path (pinned shardings re-lay the batch out anyway).
+    device_carry: bool = False
 
     def __post_init__(self):
         if self.batch_size < 1:
@@ -80,7 +91,8 @@ class ServeConfig:
     @classmethod
     def from_args(cls, args, *, mode: str = "sintel",
                   warm_start: bool = False,
-                  strict: Optional[bool] = None) -> "ServeConfig":
+                  strict: Optional[bool] = None,
+                  device_carry: bool = False) -> "ServeConfig":
         """Build from an argparse namespace that went through
         :func:`add_engine_args` — the ONE construction path eval_cli,
         serve_cli, and serve_bench share, so the batching knobs cannot
@@ -93,6 +105,7 @@ class ServeConfig:
             warm_start=warm_start,
             strict=(getattr(args, "strict", False)
                     if strict is None else strict),
+            device_carry=device_carry,
         )
 
 
@@ -179,6 +192,13 @@ class InferenceEngine:
         from dexiraft_tpu.analysis.guards import RecompileWatch
 
         self.watch = RecompileWatch("serve")
+        # device-carry machinery (config.device_carry): cached per-shape
+        # device zero rows (cold seeds) and the jitted row stack that
+        # assembles a batch's flow_init on device — one executable per
+        # (batch_size, row shape) signature, compiled inside the
+        # bucket's expected first-dispatch window
+        self._zero_rows: Dict[Tuple[int, ...], Any] = {}
+        self._stack_fn = None
 
     # ---- input validation ----------------------------------------------
 
@@ -227,8 +247,13 @@ class InferenceEngine:
                 f"{shapes['image2']} must agree (one flow field per pair)")
         fi = item.get("flow_init")
         if fi is not None:
-            fi = item["flow_init"] = np.asarray(fi)
-            # spatial dims are bucket-relative (the carry stays at the
+            if not (hasattr(fi, "ndim") and hasattr(fi, "shape")):
+                fi = item["flow_init"] = np.asarray(fi)
+            # a real array — numpy OR a jax device array (the session
+            # store's device-resident carry) — passes through untouched:
+            # np.asarray on a device array would be exactly the implicit
+            # D2H transfer the device-carry path exists to remove.
+            # Spatial dims are bucket-relative (the carry stays at the
             # PADDED 1/8 resolution), so only rank/channels are checkable
             if fi.ndim != 3 or fi.shape[-1] != 2:
                 raise ValueError(
@@ -257,20 +282,31 @@ class InferenceEngine:
         im1 = np.stack(im1)
         im2 = np.stack(im2)
 
-        bh, bw = bucket
         inits = [it.get("flow_init") for _, it in group]
-        fi = None
-        if cfg.warm_start or any(x is not None for x in inits):
-            fi = np.zeros((cfg.batch_size, bh // cfg.stride,
-                           bw // cfg.stride, 2), np.float32)
-            for row, init in enumerate(inits):
-                if init is not None:
-                    fi[row] = np.asarray(init, np.float32)
-
-        im1, im2, fi = self.put((im1, im2, fi))
-        fresh = self.registry.mark_compiled((bucket, fi is not None))
-        t1 = time.perf_counter()
-        flow_low, flow_up = self.eval_fn(im1, im2, fi)
+        will_fi = cfg.warm_start or any(x is not None for x in inits)
+        fresh = self.registry.mark_compiled((bucket, will_fi))
+        # every expected first-dispatch compile rides ONE sanctioned
+        # window: the watch is SHARED with the streaming engine
+        # (process-global compile counter), whose handler-thread check
+        # must not read an in-progress expected compile as drift. That
+        # covers the carry stack fn (_assemble_fi device path), the
+        # bucket step itself, and the per-row carry slices below.
+        win = (self.watch.sanctioned() if fresh
+               else contextlib.nullcontext())
+        with win:
+            fi = self._assemble_fi(bucket, inits) if will_fi else None
+            im1, im2, fi = self.put((im1, im2, fi))
+            t1 = time.perf_counter()
+            flow_low, flow_up = self.eval_fn(im1, im2, fi)
+            if (fresh and cfg.device_carry
+                    and not isinstance(flow_low, np.ndarray)):
+                # pre-compile the per-row carry slices: _fetch_one's
+                # low[row] is one executable per STATIC row index, and
+                # warmup batches carry one real item — without this the
+                # first multi-warm batch would compile rows 1.. after
+                # mark_warm and trip a --strict check
+                for row in range(cfg.batch_size):
+                    flow_low[row]
         t2 = time.perf_counter()
         if fresh:
             # the first call on a fresh signature traces+compiles
@@ -297,6 +333,64 @@ class InferenceEngine:
         self.stats.peak_inflight = max(self.stats.peak_inflight,
                                        len(self._inflight))
 
+    def _assemble_fi(self, bucket: Tuple[int, int], inits: List[Any]):
+        """The dispatch group's (batch_size, h/8, w/8, 2) flow_init.
+
+        Host path (device_carry off): a host zeros batch with warm rows
+        copied in, transferred with the frames — the PR 6 semantics,
+        with the warm rows' bytes counted as carry H2D traffic.
+
+        Device path (device_carry on — ALWAYS, even for an all-cold
+        group, so a warmup dispatch compiles the same executables real
+        warm traffic rides): rows are stacked ON DEVICE by a jitted
+        stack over cached zero rows — warm device rows are never
+        fetched, cold rows reuse one resident zero row, and the only
+        executable is one stack per (batch_size, row shape), compiled
+        inside the bucket's expected first-dispatch window.
+        """
+        cfg = self.config
+        bh, bw = bucket
+        shape = (bh // cfg.stride, bw // cfg.stride, 2)
+        if not cfg.device_carry:
+            if any(init is not None and not isinstance(init, np.ndarray)
+                   for init in inits):
+                raise ValueError(
+                    "a device-array flow_init reached an engine without "
+                    "ServeConfig(device_carry=True) — np.asarray on it "
+                    "would silently round-trip the carry through the "
+                    "host; enable device_carry or hand host numpy")
+            fi = np.zeros((cfg.batch_size,) + shape, np.float32)
+            for row, init in enumerate(inits):
+                if init is not None:
+                    fi[row] = np.asarray(init, np.float32)
+                    self.stats.carry_h2d_bytes += fi[row].nbytes
+            return fi
+        import jax  # deferred: module stays importable without jax
+
+        zero = self._zero_rows.get(shape)
+        if zero is None:
+            # explicit H2D (jaxlint JL007 / strict transfer guard): one
+            # resident zero row per shape seeds every cold slot
+            zero = self._zero_rows[shape] = jax.device_put(
+                np.zeros(shape, np.float32))
+        rows = []
+        for init in inits:
+            if init is None:
+                rows.append(zero)
+            elif isinstance(init, np.ndarray):
+                # mixed stream: a host-carry row (e.g. a client-supplied
+                # seed) rides an explicit put; still counted as carry
+                # H2D — it IS host carry traffic
+                self.stats.carry_h2d_bytes += init.nbytes
+                rows.append(jax.device_put(
+                    np.ascontiguousarray(init, np.float32)))
+            else:
+                rows.append(init)
+        rows += [zero] * (cfg.batch_size - len(rows))
+        if self._stack_fn is None:
+            self._stack_fn = jax.jit(lambda *rs: jax.numpy.stack(rs))
+        return self._stack_fn(*rows)
+
     # ---- fetch side ----------------------------------------------------
 
     def _fetch_one(self) -> Iterator[Result]:
@@ -313,7 +407,19 @@ class InferenceEngine:
             # explicit device->host fetch (jaxlint JL007): this sync IS
             # the fetch side's job, and device_get passes a strict
             # transfer guard
-            low = jax.device_get(ticket.flow_low)
+            if self.config.device_carry:
+                # the carry consumer (session splat) lives on device —
+                # keep flow_low there; Result.flow_low rows become
+                # device slices and the carry never crosses the bus
+                low = ticket.flow_low
+            else:
+                low = jax.device_get(ticket.flow_low)
+                if self.config.warm_start:
+                    # carry traffic only when the engine is configured
+                    # for session carry (serve sets warm_start with
+                    # sessions); a stateless replica's flow_low fetch is
+                    # plain Result plumbing, not carry bytes
+                    self.stats.carry_d2h_bytes += low.nbytes
             up = jax.device_get(ticket.flow_up)
         now = time.perf_counter()
         self.stats.fetch_s += now - t0
@@ -417,6 +523,9 @@ class InferenceEngine:
             "fetch_blocked_ms": round(self.stats.fetch_s * 1e3, 2),
             "dispatch_ms": round(self.stats.dispatch_s * 1e3, 2),
             "compile_s": round(self.compile_s, 2),
+            "device_carry": self.config.device_carry,
+            "carry_h2d_bytes": self.stats.carry_h2d_bytes,
+            "carry_d2h_bytes": self.stats.carry_d2h_bytes,
             "latency_p50_ms": round(self.stats.latency_ms(50), 2),
             "latency_p99_ms": round(self.stats.latency_ms(99), 2),
             **self.registry.stats(),

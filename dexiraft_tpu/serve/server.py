@@ -17,6 +17,16 @@ Endpoints:
                     ``X-Bucket`` headers describe what served it.
                     400 malformed, 503 queue-full/draining, 504 SLO-
                     timeout, 500 engine error.
+  POST /v1/flow/stream
+                    body = .npz with ``frames`` (T, H, W, 3) — one CHUNK
+                    of a video stream through the split-encoder
+                    streaming engine (serve/video.py): each frame is
+                    encoded ONCE, the previous frame's features + flow
+                    seed ride the device-resident session carry keyed by
+                    ``X-Session-Id``. Response: .npz ``flows``
+                    (N, H, W, 2) with N = T warm / T-1 cold
+                    (X-Frames-In / X-Flows-Out headers spell it out).
+                    404 when streaming is disabled on the replica.
   GET  /healthz     JSON READINESS; 200 while serving, 503 once
                     draining (load balancers stop routing before the
                     exit). The payload always carries {draining,
@@ -101,6 +111,54 @@ def decode_response(body: bytes) -> np.ndarray:
     """Client side: response body -> (H, W, 2) float32 flow."""
     z = np.load(io.BytesIO(body), allow_pickle=False)
     return z["flow_up"]
+
+
+# ---- streaming wire format (POST /v1/flow/stream) -----------------------
+
+
+def encode_stream_request(frames) -> bytes:
+    """Client side: one CHUNK of a video stream -> the POST
+    /v1/flow/stream body. ``frames`` is (T, H, W, 3) [0, 255] — T
+    same-geometry frames; the carry across chunks rides the
+    ``X-Session-Id`` header, so a client streams arbitrary-length video
+    as a sequence of bounded chunks."""
+    buf = io.BytesIO()
+    np.savez(buf, frames=np.asarray(frames, np.float32))
+    return buf.getvalue()
+
+
+def decode_stream_request(body: bytes) -> np.ndarray:
+    """Server side: POST body -> (T, H, W, 3) frames array. ValueError
+    on any malformed payload (the handler's 400 path); shape/dtype
+    validation is VideoEngine.validate_frames' job."""
+    try:
+        z = np.load(io.BytesIO(body), allow_pickle=False)
+        arrays = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ValueError(f"body is not a readable .npz archive: {e}")
+    if "frames" not in arrays:
+        raise ValueError(f"npz body missing required array 'frames' "
+                         f"(got {sorted(arrays)})")
+    return arrays["frames"]
+
+
+def encode_stream_response(flows) -> bytes:
+    """(N, H, W, 2) stacked flows (N may be T or T-1 — a cold chunk has
+    no carry pair for its first frame; N=0 for a cold single-frame
+    chunk that only primed the carry)."""
+    buf = io.BytesIO()
+    if len(flows):
+        arr = np.stack([np.asarray(f, np.float32) for f in flows])
+    else:
+        arr = np.zeros((0,), np.float32)
+    np.savez(buf, flows=arr)
+    return buf.getvalue()
+
+
+def decode_stream_response(body: bytes) -> np.ndarray:
+    """Client side: response body -> (N, H, W, 2) float32 flows."""
+    z = np.load(io.BytesIO(body), allow_pickle=False)
+    return z["flows"]
 
 
 # ---- HTTP plumbing ------------------------------------------------------
@@ -221,7 +279,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_json(
                 400, "unsupported Transfer-Encoding or bad Content-Length")
             return
-        if urlparse(self.path).path != "/v1/flow":
+        path = urlparse(self.path).path
+        if path == "/v1/flow/stream":
+            self._post_stream(svc, body)
+            return
+        if path != "/v1/flow":
             self._send_error_json(404, f"no such endpoint {self.path!r}")
             return
         try:
@@ -275,6 +337,50 @@ class _Handler(BaseHTTPRequestHandler):
                    {"X-Warm-Start": "1" if warm else "0",
                     "X-Bucket": f"{bucket[0]}x{bucket[1]}"})
 
+    def _post_stream(self, svc: "FlowService", body: bytes) -> None:
+        """POST /v1/flow/stream: one chunk of a video stream through the
+        split-encoder VideoEngine. The response's ``flows`` array may be
+        one SHORTER than the chunk (cold start has no carry pair for the
+        first frame) — X-Frames-In / X-Flows-Out spell it out."""
+        if svc.video is None:
+            self._send_error_json(
+                404, "streaming is not enabled on this replica (start "
+                     "serve with sessions on and --stream_sessions_mb "
+                     "> 0; docs/serving.md \"Streaming\")")
+            return
+        if svc.draining:
+            self._send_error_json(503, "draining: service is shutting "
+                                       "down")
+            return
+        try:
+            frames = decode_stream_request(body)
+            frames = svc.video.validate_frames(frames)
+        except ValueError as e:
+            self._send_error_json(400, str(e))
+            return
+        session_id = self.headers.get("X-Session-Id")
+        try:
+            res = svc.video.process_chunk(session_id, frames)
+        except Exception as e:
+            from dexiraft_tpu.serve.video import StreamOverloaded
+
+            if isinstance(e, StreamOverloaded):
+                # bounded admission, scheduler.QueueFull discipline:
+                # shed with a retry signal instead of pinning handler
+                # threads behind one in-flight chunk
+                self._send_error_json(503, str(e), retry=True)
+                return
+            self._send_error_json(
+                500, f"streaming inference failed: "
+                     f"{type(e).__name__}: {e}")
+            return
+        self._send(200, encode_stream_response(res.flows),
+                   "application/x-npz",
+                   {"X-Warm-Start": "1" if res.warm else "0",
+                    "X-Bucket": f"{res.bucket[0]}x{res.bucket[1]}",
+                    "X-Frames-In": str(res.frames_in),
+                    "X-Flows-Out": str(len(res.flows))})
+
 
 # ---- the service object -------------------------------------------------
 
@@ -302,6 +408,7 @@ class FlowService:
         carry_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         request_timeout_s: float = 60.0,
         reuse_port: bool = False,
+        video=None,
         clock=None,
     ):
         if clock is None:
@@ -309,6 +416,10 @@ class FlowService:
 
             clock = time.monotonic
         self.engine = engine
+        # optional streaming tier (serve.video.VideoEngine): owns its
+        # own device-carry session store and serialization; None keeps
+        # /v1/flow/stream answering 404 with a how-to-enable message
+        self.video = video
         self.clock = clock
         self.scheduler = Scheduler(engine, slo_ms=slo_ms,
                                    max_queue=max_queue, clock=clock)
@@ -357,7 +468,11 @@ class FlowService:
         return {
             "status": "draining" if self.draining else "ok",
             "draining": self.draining,
-            "inflight": self.scheduler.inflight(),
+            # streaming chunks bypass the scheduler, so they count here
+            # explicitly — a drain that polled scheduler inflight alone
+            # would restart a replica over a live stream
+            "inflight": self.scheduler.inflight()
+            + (self.video.inflight() if self.video is not None else 0),
             "sessions": len(self.sessions) if self.sessions is not None
             else 0,
             "uptime_s": round(self.uptime_s(), 3),
@@ -376,6 +491,8 @@ class FlowService:
             "scheduler": self.scheduler.stats_record(),
             "sessions": (self.sessions.stats_record()
                          if self.sessions is not None else None),
+            "video": (self.video.stats_record()
+                      if self.video is not None else None),
         }
 
     def _post_dispatch(self, bucket, results) -> None:
@@ -402,6 +519,8 @@ class FlowService:
         self.scheduler.stats.reset()
         if self.sessions is not None:
             self.sessions.reset_counters()
+        if self.video is not None:
+            self.video.reset_stats()
 
     def reset_stats(self) -> None:
         """One measurement-window handoff across every layer: engine

@@ -27,13 +27,19 @@ Semantics:
 
 A session holds ONE most-recent carry, not history: flow_init for frame
 j+1 is exactly frame j's (splatted) flow_low, nothing older matters.
+
+Two stores live here: :class:`SessionStore` (the PR 6 flow-seed carry —
+one small array per stream, TTL+LRU is enough) and
+:class:`DeviceSessionStore` (the streaming tier's per-frame FEATURE
+carry — device arrays heavy enough that a BYTE budget governs
+admission; see its docstring for the math).
 """
 
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -112,9 +118,13 @@ class SessionStore:
 
     def put(self, session_id: str, bucket: Tuple[int, int],
             carry: Any) -> None:
-        """Record the stream's newest carry (frame j's splatted flow_low,
-        already host numpy — the engine fetches before yielding)."""
-        carry = np.asarray(carry)
+        """Record the stream's newest carry (frame j's splatted flow_low).
+        Host numpy OR a device array: the device-resident handoff
+        (serve_cli default since the streaming PR) stores the jax array
+        as-is — np.asarray on it would be the exact D2H round-trip the
+        handoff removes — while list-like host input still normalizes."""
+        if not hasattr(carry, "shape"):
+            carry = np.asarray(carry)
         now = self.clock()
         with self._lock:
             self._sweep(now)
@@ -154,4 +164,202 @@ class SessionStore:
                 "expired": self.expired,
                 "lru_evicted": self.lru_evicted,
                 "bucket_resets": self.bucket_resets,
+            }
+
+
+# --------------------------------------------------------------------------
+# device-resident streaming carry
+# --------------------------------------------------------------------------
+
+
+def carry_nbytes(features: Dict[str, Any], flow_init: Any) -> int:
+    """HBM bytes one stream's carry pins: every feature array plus the
+    flow seed. Works on numpy AND jax arrays (both expose .nbytes
+    without a transfer) — the store never touches array CONTENTS, so it
+    stays importable and unit-testable without jax."""
+    total = 0 if flow_init is None else int(flow_init.nbytes)
+    for v in features.values():
+        total += int(v.nbytes)
+    return total
+
+
+class _DeviceEntry:
+    __slots__ = ("bucket", "features", "flow_init", "nbytes", "t_touch")
+
+    def __init__(self, bucket: Tuple[int, int], features: Dict[str, Any],
+                 flow_init: Any, nbytes: int, t_touch: float):
+        self.bucket = bucket
+        self.features = features
+        self.flow_init = flow_init
+        self.nbytes = nbytes
+        self.t_touch = t_touch
+
+
+class DeviceSessionStore:
+    """Byte-budgeted TTL+LRU map: stream id -> the DEVICE-resident
+    streaming carry {per-frame feature dict, splatted flow_init}.
+
+    The streaming path's carry is much heavier than the PR 6 flow seed:
+    a 256-channel fmap + ctx (and the edge twins for v4/v5) at the
+    bucket's 1/8 resolution — hundreds of KB to tens of MB per stream
+    depending on geometry. Keeping it on device is the whole point (no
+    per-frame H2D/D2H carry traffic, no re-encode of the shared frame),
+    which means N streams x cached features now pin HBM. So on top of
+    SessionStore's TTL + max_sessions discipline this store enforces a
+    BYTE budget: admitting or growing a carry evicts least-recently-used
+    streams until the total fits, and every eviction is counted for
+    /stats (``budget_evicted``). One over-budget stream is kept (and
+    counted via ``over_budget``) rather than thrashing itself cold.
+
+    The arrays are stored as handed in — jax device arrays from the
+    jitted encode/splat steps (their shardings are whatever the step's
+    LAYOUT-pinned out_shardings resolved; the store never re-lays them
+    out) or plain numpy in unit tests. Only ``.nbytes`` is ever read, so
+    the module keeps the serve tier's no-jax-at-import contract.
+    """
+
+    def __init__(self, budget_bytes: int = 256 << 20, ttl_s: float = 60.0,
+                 max_sessions: int = 1024, clock=None):
+        if budget_bytes < 1:
+            raise ValueError(f"budget_bytes must be >= 1, got {budget_bytes}")
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        if max_sessions < 1:
+            raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
+        if clock is None:
+            import time
+
+            clock = time.monotonic
+        self.budget_bytes = budget_bytes
+        self.ttl_s = ttl_s
+        self.max_sessions = max_sessions
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, _DeviceEntry]" = \
+            collections.OrderedDict()
+        self.bytes_in_use = 0
+        self.peak_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.expired = 0
+        self.lru_evicted = 0       # max_sessions evictions
+        self.budget_evicted = 0    # byte-budget evictions
+        self.bucket_resets = 0     # geometry moved buckets -> cold restart
+        self.over_budget = 0       # single stream alone exceeded the budget
+
+    # ---- internal (lock held) ------------------------------------------
+
+    def _drop(self, sid: str) -> None:
+        e = self._entries.pop(sid)
+        self.bytes_in_use -= e.nbytes
+
+    def _sweep(self, now: float) -> None:
+        dead = [sid for sid, e in self._entries.items()
+                if now - e.t_touch > self.ttl_s]
+        for sid in dead:
+            self._drop(sid)
+        self.expired += len(dead)
+
+    def _evict_to_fit(self, keep: str) -> None:
+        """Evict LRU streams (never ``keep``) until the budget holds."""
+        while self.bytes_in_use > self.budget_bytes:
+            victim = next((sid for sid in self._entries if sid != keep),
+                          None)
+            if victim is None:
+                # the surviving stream alone busts the budget: keep it
+                # (evicting the carry just written would silently turn
+                # streaming into cold pairs) but make it observable
+                self.over_budget += 1
+                return
+            self._drop(victim)
+            self.budget_evicted += 1
+
+    # ---- handler-thread API --------------------------------------------
+
+    def get(self, session_id: str, bucket: Tuple[int, int]
+            ) -> Optional[Tuple[Dict[str, Any], Any]]:
+        """(features, flow_init) for the stream at this bucket, or None
+        (cold: unknown id, TTL-expired, or the stream changed buckets —
+        a misaligned carry is worse than a cold start, so a bucket
+        change restarts exactly that stream)."""
+        now = self.clock()
+        with self._lock:
+            e = self._entries.get(session_id)
+            if e is None:
+                self.misses += 1
+                return None
+            if now - e.t_touch > self.ttl_s:
+                self._drop(session_id)
+                self.expired += 1
+                return None
+            if e.bucket != bucket:
+                self._drop(session_id)
+                self.bucket_resets += 1
+                return None
+            e.t_touch = now
+            self._entries.move_to_end(session_id)
+            self.hits += 1
+            return e.features, e.flow_init
+
+    def put(self, session_id: str, bucket: Tuple[int, int],
+            features: Dict[str, Any], flow_init: Any) -> None:
+        """Record the stream's newest carry (the just-encoded frame's
+        features + the splatted flow seed), evicting LRU streams if the
+        byte budget demands it."""
+        nbytes = carry_nbytes(features, flow_init)
+        now = self.clock()
+        with self._lock:
+            self._sweep(now)
+            if session_id in self._entries:
+                self._drop(session_id)
+            while len(self._entries) >= self.max_sessions:
+                self._drop(next(iter(self._entries)))
+                self.lru_evicted += 1
+            self._entries[session_id] = _DeviceEntry(
+                bucket, features, flow_init, nbytes, now)
+            self.bytes_in_use += nbytes
+            self._evict_to_fit(keep=session_id)
+            self.peak_bytes = max(self.peak_bytes, self.bytes_in_use)
+
+    def drop(self, session_id: str) -> bool:
+        """Explicitly forget one stream (the streaming endpoint's
+        bucket-change reset); True if it existed."""
+        with self._lock:
+            if session_id not in self._entries:
+                return False
+            self._drop(session_id)
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def reset_counters(self) -> None:
+        """Zero the flow counters (the /stats?reset=1 window handoff);
+        live carries — actual state — survive, as do the byte gauges
+        that describe them (bytes_in_use is state, not a statistic)."""
+        with self._lock:
+            self.hits = self.misses = self.expired = 0
+            self.lru_evicted = self.budget_evicted = 0
+            self.bucket_resets = self.over_budget = 0
+            self.peak_bytes = self.bytes_in_use
+
+    def stats_record(self) -> dict:
+        """Self-describing blob for the /stats endpoint."""
+        with self._lock:
+            self._sweep(self.clock())
+            return {
+                "active": len(self._entries),
+                "ttl_s": self.ttl_s,
+                "max_sessions": self.max_sessions,
+                "budget_mb": round(self.budget_bytes / 2**20, 2),
+                "bytes_in_use_mb": round(self.bytes_in_use / 2**20, 3),
+                "peak_mb": round(self.peak_bytes / 2**20, 3),
+                "hits": self.hits,
+                "misses": self.misses,
+                "expired": self.expired,
+                "lru_evicted": self.lru_evicted,
+                "budget_evicted": self.budget_evicted,
+                "bucket_resets": self.bucket_resets,
+                "over_budget": self.over_budget,
             }

@@ -17,6 +17,13 @@ mode) unless an external sticky router fronts the pool
 
 SIGTERM drains: admitted requests finish and flush before exit
 (PR 4's preemption discipline, service-shaped); a second signal aborts.
+
+Session carry is DEVICE-RESIDENT by default (the splat result never
+leaves the chip; --host_carry restores the PR 6 host round-trip), and
+single-worker session-enabled replicas also serve chained video through
+``POST /v1/flow/stream`` — the split-encoder streaming engine
+(serve/video.py) with a byte-budgeted device carry
+(--stream_sessions_mb; docs/serving.md "Streaming").
 """
 
 from __future__ import annotations
@@ -86,6 +93,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--session_ttl_s", type=float, default=60.0,
                    help="session warm-start TTL; 0 disables sessions "
                         "(stateless mode, forced when --workers > 1)")
+    p.add_argument("--host_carry", action="store_true",
+                   help="keep the PR 6 host-numpy session carry "
+                        "(device_get per response + H2D per warm "
+                        "request) instead of the device-resident "
+                        "handoff; for pools/externally-restarted "
+                        "workers that cannot share device state. "
+                        "Implied by --workers > 1 and --data_parallel")
+    p.add_argument("--stream_sessions_mb", type=float, default=256.0,
+                   help="HBM byte budget for the streaming tier's "
+                        "device-resident feature carries (POST "
+                        "/v1/flow/stream; LRU-evicted past it, counted "
+                        "in /stats). 0 disables the streaming endpoint")
+    p.add_argument("--stream_chunk_frames", type=int, default=64,
+                   help="max frames per /v1/flow/stream chunk (400 past "
+                        "it): one chunk holds the streaming engine for "
+                        "its whole frame loop, so the cap bounds how "
+                        "long one request can starve other streams")
     p.add_argument("--request_timeout_s", type=float, default=60.0,
                    help="per-request server-side wait bound (504 past it)")
     p.add_argument("--workers", type=int, default=1,
@@ -205,25 +229,37 @@ def _load(args):
     return cfg, state.variables
 
 
-def _make_carry_fn():
+def _make_carry_fn(device: bool = True):
     """Session carry = the submission loop's splat: the previous frame's
-    low-res flow forward-interpolated to the next frame's grid, fetched
-    once (explicitly) to host numpy for the store."""
+    low-res flow forward-interpolated to the next frame's grid.
+
+    device=True (the default): the splat result STAYS a device array —
+    the store holds it, the engine stacks it into the next warm batch on
+    device, and the carry path moves zero host<->device bytes per frame
+    (engine.stats carry_h2d/d2h_bytes pin it). device=False keeps the
+    PR 6 host round-trip (explicit device_get here, H2D on the next
+    request) for deployments whose workers cannot share device state
+    (--host_carry, pools, the data-parallel mesh path)."""
     import jax
 
     from dexiraft_tpu.eval.interpolate import forward_interpolate
 
+    if device:
+        return forward_interpolate
     return lambda flow_low: jax.device_get(forward_interpolate(flow_low))
 
 
-def _warmup(engine, geometries, carry_fn=None) -> None:
+def _warmup(engine, geometries, carry_fn=None, video=None) -> None:
     """Pre-compile the named buckets before the listener opens: the
     first real request on a cold bucket would otherwise eat the compile
     inside its latency budget. With sessions on, the engine always
     materializes flow_init (warm_start=True), so one signature per
     bucket covers cold AND warm traffic — and the carry splat
     (forward_interpolate, jitted per bucket shape) compiles here too,
-    so --strict serving is compile-flat from the first request."""
+    so --strict serving is compile-flat from the first request. With
+    streaming enabled the video engine warms the same geometries (its
+    encode/refine/splat signatures), extending the compile-flat
+    guarantee to /v1/flow/stream."""
     import numpy as np
 
     for geom in geometries:
@@ -234,7 +270,66 @@ def _warmup(engine, geometries, carry_fn=None) -> None:
         if carry_fn is not None:
             carry_fn(res.flow_low)
             engine.watch.mark_warm()  # expected compile, not drift
+    if video is not None:
+        video.warmup(geometries)
     engine.reset_stats()  # warmup is not traffic
+
+
+def _make_video_engine(args, cfg, variables, mesh, sessions_on,
+                       watch=None):
+    """The streaming tier (serve/video.py), or None with a printed why.
+
+    Streaming needs sessions (the carry IS the feature), a budget, a
+    single-chip step (the chunk loop is batch-1 serially dependent —
+    sharding one frame over a data mesh is the wrong axis), and a
+    variant whose edges don't come from the dataset (v2/v3 without
+    embed_dexined would need per-frame edge images on the wire)."""
+    why = None
+    if args.stream_sessions_mb <= 0:
+        why = "--stream_sessions_mb 0"
+    elif not sessions_on:
+        why = "sessions off (the carry needs a session store)"
+    elif mesh is not None:
+        why = "--data_parallel (batch-1 chunks do not shard)"
+    elif cfg.variant in ("early", "separate") and not cfg.embed_dexined:
+        why = (f"variant {cfg.variant!r} needs data-supplied edge "
+               "frames the stream wire format does not carry")
+    if why is not None:
+        print(f"[serve] streaming endpoint disabled: {why}", flush=True)
+        return None
+
+    import jax
+
+    from dexiraft_tpu.eval.interpolate import forward_interpolate
+    from dexiraft_tpu.serve.sessions import DeviceSessionStore
+    from dexiraft_tpu.serve.video import VideoEngine
+    from dexiraft_tpu.train.step import make_encode_step, make_refine_step
+
+    encode_step = make_encode_step(cfg)
+    refine_step = make_refine_step(cfg, iters=args.iters)
+    # the splat stays on device: flow_low (1, h/8, w/8, 2) -> the next
+    # pair's seed, one jitted executable per bucket shape (warmup
+    # absorbs the compile)
+    splat = jax.jit(lambda low: forward_interpolate(low[0])[None])
+    store = DeviceSessionStore(
+        budget_bytes=int(args.stream_sessions_mb * 2**20),
+        ttl_s=args.session_ttl_s,
+        max_sessions=1024)
+    return VideoEngine(
+        lambda frame: encode_step(variables, frame),
+        lambda f1, f2, fi: refine_step(variables, f1, f2, fi),
+        splat,
+        sessions=store,
+        put=jax.device_put,
+        mode=args.mode,
+        bucket_multiple=args.bucket_multiple,
+        max_chunk_frames=args.stream_chunk_frames,
+        strict=args.strict,
+        # ONE RecompileWatch with the pair engine: the backend compile
+        # counter is process-global, so a separate watch would let a
+        # cold streaming bucket's expected compile read as drift to the
+        # pair dispatcher's --strict check (and vice versa)
+        watch=watch)
 
 
 def _serve_one(args) -> None:
@@ -250,21 +345,36 @@ def _serve_one(args) -> None:
 
     cfg, variables = _load(args)
 
+    # one resident copy of the weights: the pair eval step and the
+    # streaming encode/refine steps all close over THIS device tree
+    # (device_put inside _make_eval_fn is a no-op on it)
+    variables = jax.device_put(variables)
+
     from dexiraft_tpu.eval_cli import _make_eval_fn
     from dexiraft_tpu.serve import InferenceEngine
     from dexiraft_tpu.serve.server import FlowService
 
     eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters)
     sessions_on = args.session_ttl_s > 0
+    # device-resident carry is the default; the host round-trip stays
+    # behind --host_carry (and is forced on the data-parallel mesh path,
+    # whose pinned in_shardings re-lay the batch out host-side anyway)
+    device_carry = sessions_on and not args.host_carry and mesh is None
     engine = InferenceEngine(
         eval_fn,
-        ServeConfig.from_args(args, mode=args.mode, warm_start=sessions_on),
+        ServeConfig.from_args(args, mode=args.mode, warm_start=sessions_on,
+                              device_carry=device_carry),
         mesh=mesh)
-    carry_fn = _make_carry_fn() if sessions_on else None
+    carry_fn = (_make_carry_fn(device=device_carry)
+                if sessions_on else None)
+    video = _make_video_engine(args, cfg, variables, mesh, sessions_on,
+                               watch=engine.watch)
     if args.warmup:
-        _warmup(engine, args.warmup.split(","), carry_fn)
+        _warmup(engine, args.warmup.split(","), carry_fn, video)
         print(f"[serve] warmup: compiled "
-              f"{engine.registry.compiles} signature(s)", flush=True)
+              f"{engine.registry.compiles} signature(s)"
+              f"{' (+streaming)' if video is not None else ''}",
+              flush=True)
 
     service = FlowService(
         engine,
@@ -273,7 +383,8 @@ def _serve_one(args) -> None:
         session_ttl_s=args.session_ttl_s,
         carry_fn=carry_fn,
         request_timeout_s=args.request_timeout_s,
-        reuse_port=args.reuse_port)
+        reuse_port=args.reuse_port,
+        video=video)
     service.install_signal_handlers()
     service.start()
     worker = os.environ.get("DEXIRAFT_SERVE_WORKER")

@@ -345,3 +345,74 @@ def make_eval_step(
         in_shardings=(repl, data, data, data, data, data),
         out_shardings=(data, data),
     )
+
+
+def make_encode_step(
+    cfg: RAFTConfig,
+    mesh: Optional[Mesh] = None,
+) -> Callable[..., Dict[str, jax.Array]]:
+    """Jitted per-frame encoder stage (RAFT mode="encode").
+
+    (variables, frame [, edges]) -> the frame's feature dict {fmap, ctx
+    [, efmap, ectx]} — everything a frame contributes to any pair it
+    joins. The streaming video path runs this ONCE per new frame; the
+    previous frame's dict comes from the device-resident session carry
+    (serve.sessions.DeviceSessionStore), so a chained stream pays half
+    the encoder FLOPs of repeated pair calls. Composes with
+    :func:`make_refine_step` to reproduce the monolithic eval step
+    exactly (parity pinned in tests/test_zzvideo.py).
+
+    With a mesh, shardings pin like make_eval_step: variables
+    replicated, frame batch (and every feature-dict leaf — all leaves
+    are batch-leading >=3D) over the 'data' axis.
+    """
+    model = RAFT(cfg)
+
+    def encode(
+        variables: Dict[str, Any],
+        frame: jax.Array,
+        edges: Optional[jax.Array] = None,
+    ) -> Dict[str, jax.Array]:
+        return model.apply(variables, frame, edges1=edges, train=False,
+                           mode="encode")
+
+    if mesh is None:
+        return jax.jit(encode)
+    repl = replicated_sharding(mesh)
+    data = batch_input_sharding(mesh)
+    return jax.jit(encode, in_shardings=(repl, data, data),
+                   out_shardings=data)
+
+
+def make_refine_step(
+    cfg: RAFTConfig,
+    iters: int = 24,
+    mesh: Optional[Mesh] = None,
+) -> Callable[..., Tuple[jax.Array, jax.Array]]:
+    """Jitted refinement stage (RAFT mode="step"), test-mode returns.
+
+    (variables, features1, features2, flow_init) -> (flow_low, flow_up)
+    where features1 is the EARLIER frame's dict (its ctx seeds the GRU)
+    and flow_init is always materialized (a zeros flow_init equals no
+    warm start — the engine's one-executable-per-bucket contract).
+    Same param tree as the monolithic step; checkpoints interchange.
+    """
+    model = RAFT(cfg)
+
+    def refine(
+        variables: Dict[str, Any],
+        features1: Dict[str, jax.Array],
+        features2: Dict[str, jax.Array],
+        flow_init: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        return model.apply(variables, None, iters=iters,
+                           flow_init=flow_init, train=False,
+                           test_mode=True, mode="step",
+                           features1=features1, features2=features2)
+
+    if mesh is None:
+        return jax.jit(refine)
+    repl = replicated_sharding(mesh)
+    data = batch_input_sharding(mesh)
+    return jax.jit(refine, in_shardings=(repl, data, data, data),
+                   out_shardings=(data, data))

@@ -78,6 +78,7 @@ HARD_CAP_S = 1500.0
 RECORD_KEYS = {  # pinned by tests/test_zserve_bench.py
     "metric", "platform", "variant", "iters", "sizes", "frames",
     "bucket_multiple", "configs", "speedup_batched_over_b1",
+    "corr_impl_resolved",
 }
 CONFIG_KEYS = {
     "batch_size", "inflight", "frame_pairs_per_sec", "latency_p50_ms",
@@ -91,7 +92,7 @@ CONFIG_KEYS = {
 CLOSED_LOOP_RECORD_KEYS = {
     "metric", "platform", "variant", "iters", "size", "batch", "slo_ms",
     "max_queue", "sequential", "levels", "overload", "warm_start",
-    "speedup_batched_over_sequential",
+    "speedup_batched_over_sequential", "corr_impl_resolved",
 }
 LEVEL_KEYS = {
     "concurrency", "requests", "goodput_rps", "p50_ms", "p99_ms",
@@ -104,7 +105,7 @@ LEVEL_KEYS = {
 FLEET_RECORD_KEYS = {
     "metric", "platform", "variant", "iters", "size", "batch", "slo_ms",
     "max_queue", "replicas", "concurrency", "requests", "scaling",
-    "kill", "goodput_scaling",
+    "kill", "goodput_scaling", "corr_impl_resolved",
 }
 FLEET_SCALING_KEYS = {
     "replicas", "concurrency", "requests", "goodput_rps", "p50_ms",
@@ -149,6 +150,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (config.update beats the "
                          "axon site-hook pin)")
+    ap.add_argument("--corr_impl", default="auto",
+                    choices=["auto", "allpairs", "local", "pallas",
+                             "flash"],
+                    help="'auto' (default) = the production config: "
+                         "flash-blocked fused step on TPU, allpairs "
+                         "off-chip; the RESOLVED value is stamped into "
+                         "every record as corr_impl_resolved so A/Bs "
+                         "are self-describing")
+    ap.add_argument("--fused_update", action="store_true",
+                    help="fused Pallas lookup+update kernel (requires "
+                         "--corr_impl flash or pallas)")
     # ---- closed-loop (service) mode ------------------------------------
     ap.add_argument("--closed_loop", action="store_true",
                     help="load-generate against the real FlowService over "
@@ -201,7 +213,15 @@ def _build_eval_fn(args, iters=None):
         cache_dir = enable_persistent_cache(args.compile_cache_dir)
         print(f"compile cache: {cache_dir}", file=sys.stderr)
 
-    cfg = getattr(C, f"raft_{args.variant}")(small=args.small)
+    # resolve --corr_impl (default "auto" -> the platform's production
+    # config) and remember the resolution for the record stamp — the
+    # eval/serve CLIs print it, the records carry it (corr_impl_resolved)
+    impl, fused = C.resolve_corr_impl_args(
+        args, jax.devices()[0].platform, "serve_bench")
+    args.corr_impl_resolved = impl
+    cfg = getattr(C, f"raft_{args.variant}")(small=args.small,
+                                             corr_impl=impl,
+                                             fused_update=fused)
     state = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     variables = {"params": state.params, "batch_stats": state.batch_stats}
 
@@ -351,6 +371,7 @@ def _measure(args) -> None:
         "sizes": args.sizes,
         "frames": args.frames,
         "bucket_multiple": args.bucket_multiple,
+        "corr_impl_resolved": args.corr_impl_resolved,
         "configs": configs,
         # None when only the baseline ran (e.g. --batch <= the
         # data-parallel baseline) — never a self-ratio of 1.0
@@ -693,6 +714,7 @@ def _measure_closed_loop(args) -> None:
         "batch": args.batch,
         "slo_ms": args.slo_ms,
         "max_queue": args.max_queue,
+        "corr_impl_resolved": args.corr_impl_resolved,
         "sequential": sequential,
         "levels": levels,
         "overload": overload,
@@ -736,7 +758,12 @@ def _fleet_serve_args(args) -> list:
           "--max_queue", str(args.max_queue),
           "--session_ttl_s", "60",
           "--bucket_multiple", str(args.bucket_multiple),
+          "--corr_impl", args.corr_impl,
           "--warmup", args.size, "--request_timeout_s", "60"]
+    if args.fused_update:
+        # without this a fleet A/B of the fused config silently spawns
+        # UNFUSED replicas (explicit --corr_impl resolves fused=False)
+        sa.append("--fused_update")
     if args.small:
         sa.append("--small")
     if args.cpu:
@@ -763,6 +790,7 @@ def _measure_fleet(args) -> None:
     import threading
     from urllib.parse import urlparse
 
+    from dexiraft_tpu.config import resolve_corr_impl
     from dexiraft_tpu.router_cli import spawn_replica, wait_ready
     from dexiraft_tpu.serve.server import encode_request
 
@@ -929,6 +957,11 @@ def _measure_fleet(args) -> None:
         "replicas": n,
         "concurrency": args.concurrency,
         "requests": args.requests,
+        # the bench process never imports jax (replicas own the devices)
+        # so it resolves for the platform the replicas run on: --cpu
+        # forces cpu everywhere, otherwise the fleet is a TPU deployment
+        "corr_impl_resolved": resolve_corr_impl(
+            args.corr_impl, "cpu" if args.cpu else "tpu")[0],
         "scaling": scaling,
         "kill": kill,
         "goodput_scaling": (

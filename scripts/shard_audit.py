@@ -47,10 +47,15 @@ sys.path.insert(0, REPO)
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("shard_audit")
-    ap.add_argument("--steps", default="train,eval,serve,train_fsdp",
+    ap.add_argument("--steps",
+                    default="train,eval,serve,serve_encode,serve_refine,"
+                            "train_fsdp",
                     help="comma-separated subset of train,eval,serve,"
-                         "train_fsdp (partial runs diff only their "
-                         "sections; train_fsdp diffs the fsdp golden)")
+                         "serve_encode,serve_refine,train_fsdp (partial "
+                         "runs diff only their sections; train_fsdp "
+                         "diffs the fsdp golden; serve_encode/"
+                         "serve_refine are the split-model streaming "
+                         "signatures)")
     ap.add_argument("--golden", default=None,
                     help="golden path (default: "
                          "dexiraft_tpu/analysis/layout_golden.json)")

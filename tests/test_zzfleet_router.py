@@ -480,6 +480,28 @@ def test_fleet_bench_record_schemas_pinned():
     assert "client_retries" in LEVEL_KEYS
 
 
+def test_fleet_replica_args_forward_corr_config():
+    """A fleet A/B of the fused config must spawn FUSED replicas:
+    --corr_impl and --fused_update both ride the replica argv (explicit
+    --corr_impl alone resolves fused=False in serve_cli)."""
+    import argparse
+
+    sys.path.insert(0, osp.join(REPO, "scripts"))
+    try:
+        from serve_bench import _fleet_serve_args
+    finally:
+        sys.path.pop(0)
+    ns = argparse.Namespace(
+        variant="v1", iters=2, batch=4, slo_ms=200, max_queue=32,
+        bucket_multiple=None, corr_impl="flash", fused_update=True,
+        size="64x96", small=True, cpu=True)
+    sa = _fleet_serve_args(ns)
+    assert "--fused_update" in sa
+    assert sa[sa.index("--corr_impl") + 1] == "flash"
+    ns.fused_update = False
+    assert "--fused_update" not in _fleet_serve_args(ns)
+
+
 # ---- the real thing: router over 2 subprocess replicas ------------------
 
 

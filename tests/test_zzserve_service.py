@@ -320,7 +320,8 @@ class TestHTTPService:
         assert status == 200 and live == {"status": "alive"}
 
         status, stats = _get_json(service.url, "/stats?reset=1")
-        assert set(stats) == {"service", "engine", "scheduler", "sessions"}
+        assert set(stats) == {"service", "engine", "scheduler", "sessions",
+                              "video"}
         assert set(stats["service"]) == {
             "uptime_s", "draining", "slo_ms", "sessions_enabled"}
         # engine blob: ServeStats + registry, incl. the bucket SHAPES

@@ -445,6 +445,31 @@ class TestGuards:
         jax.jit(lambda x: x / 9)(jnp.ones((17,)))  # unplanned: counted
         assert watch.drift >= 1
 
+    def test_check_defers_while_sanctioned_window_open(self):
+        """The serve-tier race: the pair dispatcher and the streaming
+        engine share ONE watch across threads — a check() landing while
+        the OTHER thread's sanctioned cold-bucket compile is in progress
+        must defer (the window's exit shifts the baseline), then regain
+        its teeth."""
+        import io
+
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        watch = guards.RecompileWatch("fixture")
+        watch.mark_warm()
+        with watch.sanctioned():
+            jax.jit(lambda x: x / 11)(jnp.ones((19,)))
+            assert watch.drift >= 1       # counter already moved...
+            watch.check()                 # ...but an open window defers
+            assert not watch.warn_if_drifted(file=io.StringIO())
+        assert watch.drift == 0           # exit absorbed the window
+        jax.jit(lambda x: x / 13)(jnp.ones((23,)))  # unplanned
+        with pytest.raises(guards.RecompileBudgetExceeded):
+            watch.check()
+
     def test_strict_mode_raises_on_post_warmup_compile(self):
         import jax
         import jax.numpy as jnp

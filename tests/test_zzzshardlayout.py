@@ -317,7 +317,10 @@ def _golden() -> dict:
 class TestGoldenFile:
     def test_shipped_golden_loads_and_covers_all_steps(self):
         g = _golden()
-        assert set(g["steps"]) == {"train", "eval", "serve"}
+        # serve_encode / serve_refine: the split-model streaming
+        # signatures (PR 14) audited beside the monolithic serve step
+        assert set(g["steps"]) == {"train", "eval", "serve",
+                                   "serve_encode", "serve_refine"}
         from dexiraft_tpu.parallel.layout import LAYOUT
 
         assert g["axes"] == {"data": LAYOUT.data_axis,
@@ -325,6 +328,8 @@ class TestGoldenFile:
                              "seq": LAYOUT.seq_axis}
         assert g["steps"]["train"]["mesh"] == shardaudit.TRAIN_MESH
         assert g["steps"]["serve"]["mesh"] == shardaudit.SERVE_MESH
+        assert g["steps"]["serve_encode"]["mesh"] == shardaudit.SERVE_MESH
+        assert g["steps"]["serve_refine"]["mesh"] == shardaudit.SERVE_MESH
 
     def test_volume_free_golden_with_fmap_canary(self):
         """ISSUE 12 pin: the production eval/serve config is the flash-
