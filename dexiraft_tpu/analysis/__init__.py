@@ -4,15 +4,24 @@ Two halves, deliberately decoupled:
 
 - ``jaxlint`` — pure-stdlib AST linter (no jax import) run by
   ``scripts/lint_gate.py`` as the pre-pytest CI gate. Import it by file
-  path or as ``dexiraft_tpu.analysis.jaxlint``.
+  path or as ``dexiraft_tpu.analysis.jaxlint``. Sharding-contract
+  rules (JL010+) live in ``shardlint``; lock-discipline rules (JL020+)
+  in ``threadlint`` — both pure stdlib, loaded by jaxlint by file path.
 - ``guards`` — the runtime side (imports jax): ``strict_mode()`` arms
   ``jax.transfer_guard`` plus a recompile-count sentinel so steady-state
   retraces and implicit host transfers raise instead of silently
   degrading throughput; ``RecompileWatch`` is the observe-only variant
   that powers the non-strict drift warnings.
+- ``locks`` — the concurrency runtime (pure stdlib): every fleet lock
+  is a named, rank-carrying ``OrderedLock`` feeding a per-process
+  acquisition graph, so rank inversions and ABBA deadlock cycles raise
+  at the second acquisition under strict mode, with contention /
+  held-span gauges on the serve tier's ``/stats`` ``locks`` block.
 
 This ``__init__`` imports nothing so the lint gate and tests can load
 ``jaxlint`` without paying (or even having) the jax import.
 
-See docs/static_analysis.md for the rule catalog and --strict semantics.
+See docs/static_analysis.md for the rule catalog and --strict
+semantics, and docs/serving.md "Threading model" for the declared
+lock order.
 """

@@ -30,14 +30,15 @@ from __future__ import annotations
 
 import contextlib
 import sys
-import threading
 from typing import Iterator, Optional
 
 import jax
 
+from dexiraft_tpu.analysis.locks import OrderedLock
+
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
-_lock = threading.Lock()
+_lock = OrderedLock("analysis.guards.listener")
 _installed = False
 _count = 0
 
@@ -99,11 +100,19 @@ class RecompileWatch:
         # compile counter is process-global, so a check() racing an
         # in-progress expected compile would read it as drift before
         # the window's exit shifts the baseline
-        self._slock = threading.Lock()
+        self._slock = OrderedLock("analysis.guards.watch")
         self._sanctioned_depth = 0
+        self._win_base = 0   # compile_count at the 0->1 depth transition
 
     def mark_warm(self) -> None:
-        self._warm_at = compile_count()
+        # read AND write under the window lock: engines call this from
+        # dispatcher and handler threads, and a count read before the
+        # lock can go stale against a concurrent sanctioned() exit's
+        # re-baseline — writing the stale count would re-expose the
+        # window's own compiles as drift. watch -> listener (via
+        # compile_count) is the declared LOCK_ORDER direction.
+        with self._slock:
+            self._warm_at = compile_count()
 
     @property
     def drift(self) -> int:
@@ -126,9 +135,13 @@ class RecompileWatch:
                 # the baseline past its compiles; the next check has
                 # teeth again
                 return
-        if self.drift > budget:
+            # read drift under the same lock as the depth check: a
+            # window opening (or exiting) in between would hand us a
+            # count that includes its sanctioned compiles
+            d = self.drift
+        if d > budget:
             raise RecompileBudgetExceeded(
-                f"[guards] {self.label}: {self.drift} backend compile(s) "
+                f"[guards] {self.label}: {d} backend compile(s) "
                 f"in a strict region with budget {budget} — steady state "
                 f"retraced (shape/dtype drift). Enable jax.log_compiles() "
                 f"to see what; docs/static_analysis.md has the playbook")
@@ -146,6 +159,12 @@ class RecompileWatch:
         open, concurrent :meth:`check`/:meth:`warn_if_drifted` calls
         (the other engine's dispatch on its own thread) defer rather
         than read the in-progress expected compile as drift.
+        OVERLAPPING windows (both engines compiling fresh buckets at
+        once) merge into one span: the baseline snapshots at the 0->1
+        depth transition and shifts once at 1->0, so a compile landing
+        inside two open windows is absorbed once, not twice (a double
+        shift would drive drift negative and silently extend the
+        blind spot past the windows' exit).
 
         Known blind spot, accepted: the compile counter is
         process-GLOBAL, so another thread's genuine drift landing inside
@@ -154,16 +173,23 @@ class RecompileWatch:
         per-thread counts the jax.monitoring listener does not expose;
         windows are short (cold-bucket compiles), and steady-state drift
         recurs, so the next post-window check catches a real leak."""
-        before = compile_count()
         with self._slock:
+            if self._sanctioned_depth == 0:
+                self._win_base = compile_count()
             self._sanctioned_depth += 1
         try:
             yield
         finally:
             with self._slock:
                 self._sanctioned_depth -= 1
-                if self._warm_at is not None:
-                    self._warm_at += compile_count() - before
+                if self._sanctioned_depth == 0 and self._warm_at is not None:
+                    now = compile_count()
+                    # the min-cap keeps a mark_warm() issued while the
+                    # window was open from compounding with the shift:
+                    # the baseline may land ON the current count, never
+                    # past it (negative drift would mask real retraces)
+                    self._warm_at = min(self._warm_at
+                                        + (now - self._win_base), now)
 
     def warn_if_drifted(self, file=None) -> bool:
         """One-line, once-only warning when post-warmup compiles exist.
@@ -171,12 +197,25 @@ class RecompileWatch:
         Returns True if drift was (ever) reported — callers embedding
         this in a loop get the cadence for free.
         """
+        report = False
         with self._slock:
             if self._sanctioned_depth > 0:
                 return self._warned
-        d = self.drift
-        if d > 0 and not self._warned:
-            self._warned = True
+            # drift is read INSIDE the lock, after the depth check: a
+            # sanctioned window exiting between an early read and the
+            # check would leave a stale pre-rebaseline count here — a
+            # bogus warning that latches _warned and silences every
+            # future real one. (watch -> listener nesting via
+            # compile_count() is the declared LOCK_ORDER direction.)
+            d = self.drift
+            if d > 0 and not self._warned:
+                # claim the once-only slot under the lock (two engine
+                # threads drifting together must not both print); the
+                # print itself happens after release — I/O under a lock
+                # is the JL023 shape this module now lints against
+                self._warned = True
+                report = True
+        if report:
             print(f"[guards] {self.label}: {d} recompile(s) after warmup "
                   f"— shape/dtype drift is erasing throughput; rerun "
                   f"with --strict to fail fast (docs/static_analysis.md)",

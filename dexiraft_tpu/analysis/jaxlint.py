@@ -57,11 +57,14 @@ Rule catalog (docs/static_analysis.md has the long-form version):
                            a fresh wrapper (and a retrace) per
                            iteration; hoist it.
 
-Sharding-contract rules JL010+ live in the sibling `shardlint.py`
-(loaded below by file path, so both the package import and
-lint_gate.py's path-load pick them up); they enforce that every
-PartitionSpec / mesh axis / sharding pin is drawn from the canonical
-layout in `parallel/layout.py` (docs/parallel.md).
+Sharding-contract rules JL010+ live in the sibling `shardlint.py` and
+lock-discipline rules JL020+ in `threadlint.py` (both loaded below by
+file path, so the package import and lint_gate.py's path-load pick
+them up): shardlint enforces that every PartitionSpec / mesh axis /
+sharding pin is drawn from the canonical layout in `parallel/layout.py`
+(docs/parallel.md); threadlint enforces the serve/resilience thread
+fabric's lock discipline against the central lock-order registry in
+`analysis/locks.py` (docs/serving.md "Threading model").
 
 Suppression: `# jaxlint: disable=JL00X` on the offending line, or a
 reviewed entry in analysis/baseline.json (see lint_gate.py). Baseline
@@ -93,22 +96,24 @@ RULES: Dict[str, str] = {
 }
 
 
-def _load_shardlint():
-    """Load the sibling sharding-rule module by file path (mirrors how
-    lint_gate.py loads this file): works identically whether jaxlint was
-    imported as dexiraft_tpu.analysis.jaxlint or exec'd by path."""
+def _load_rule_module(filename: str, modname: str):
+    """Load a sibling rule module by file path (mirrors how lint_gate.py
+    loads this file): works identically whether jaxlint was imported as
+    dexiraft_tpu.analysis.jaxlint or exec'd by path."""
     import importlib.util
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "shardlint.py")
-    spec = importlib.util.spec_from_file_location("_shardlint", path)
+                        filename)
+    spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-_shardlint = _load_shardlint()
+_shardlint = _load_rule_module("shardlint.py", "_shardlint")
+_threadlint = _load_rule_module("threadlint.py", "_threadlint")
 RULES.update(_shardlint.RULES)
+RULES.update(_threadlint.RULES)
 
 # dotted names that mean "jax.jit" after alias resolution
 _JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit",
@@ -336,7 +341,8 @@ class _Linter:
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     self._rule_jl004(node)
         self._rule_jl007(mod.tree)
-        _shardlint.run_rules(self)  # JL010+ sharding-contract rules
+        _shardlint.run_rules(self)   # JL010+ sharding-contract rules
+        _threadlint.run_rules(self)  # JL020+ lock-discipline rules
         rel = mod.path.replace(os.sep, "/")
         if (rel.startswith(("dexiraft_tpu/train/", "dexiraft_tpu/eval/",
                             "dexiraft_tpu/serve/"))

@@ -1,0 +1,468 @@
+"""Instrumented lock-order runtime: the dynamic half of threadlint.
+
+The serve/resilience tier is a 12-module thread fabric, and every
+concurrency bug this repo has shipped (the RouterStats unlocked `+=`,
+the VideoEngine stats-lock stall, the RecompileWatch mark_warm race,
+the flush-barrier ordering bug) was found by a human reviewer after the
+fact. threadlint (JL020+) catches the *textual* half of that class;
+this module catches the half only visible at run time:
+
+- **lock-order inversions / deadlock cycles** — every lock in the fleet
+  is an :class:`OrderedLock`: a named, rank-carrying wrapper whose rank
+  comes from the one central :data:`LOCK_ORDER` registry below.
+  Acquiring lock B while holding lock A records the edge A->B in a
+  per-process acquisition graph; an edge that closes a cycle (two code
+  paths taking the same pair in opposite orders — the ABBA deadlock) or
+  inverts the declared ranks raises :class:`LockOrderViolation` at the
+  SECOND acquisition under strict mode (``set_strict(True)``, armed by
+  ``--strict`` serving and by the test suite) and warns once per edge
+  otherwise. The detector fires *before* blocking, so a seeded deadlock
+  is a stack trace naming both locks, never a hung process.
+- **held-too-long spans + contention** — each lock keeps max/total held
+  time and a contended-acquisition count (all clock reads go through
+  the registry's injectable clock, so tests pin the math on a fake
+  clock). ``stats_record()`` is the ``locks`` block the serve tier's
+  /stats endpoints and chaos_smoke's record tail surface.
+
+Design constraints, in order: pure stdlib (serve/router must import
+this with no jax anywhere near the path); near-zero cost on the
+uncontended fast path (per-lock gauges are mutated only while the lock
+itself is held — no global lock on plain acquires; the registry's
+internal mutex is touched only for *nested* acquisitions, registration,
+and stats reads); and honest under races (a violation is counted and
+reported even when non-strict mode lets execution proceed).
+
+The declared total order is the contract reviewers used to reconstruct
+from CHANGES.md archaeology (docs/serving.md "Threading model" now
+spells it out): outermost first, so a thread may only acquire DOWN the
+list while holding earlier entries. threadlint's JL024 enforces the
+static mirror of the same registry.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: The fleet's declared total lock order, outermost first. A thread
+#: holding LOCK_ORDER[i] may acquire LOCK_ORDER[j] only for j > i.
+#: Every OrderedLock name below exists in the tree; threadlint keeps a
+#: pure-stdlib mirror of this tuple (tests/test_zzzthreadlint.py pins
+#: them equal, the shardlint LAYOUT_AXES idiom).
+LOCK_ORDER: Tuple[str, ...] = (
+    "serve.video.chunk",         # VideoEngine._lock: one chunk's frame loop
+    "serve.server.stop",         # FlowService._stop_lock: drain idempotence
+    "serve.scheduler.cv",        # Scheduler._cv: queues + dispatch decision
+    "serve.router.supervisor",   # router_cli._Supervisor._lock: child procs
+    "serve.router.autoscale",    # Router._autoscale_lock: scrape-window
+                                 # read-and-swap (nests pool + stats records)
+    "serve.router.pool",         # ReplicaPool._lock: breaker + ring + affinity
+    "serve.router.inflight",     # Router._inflight_lock: admission bound
+    "serve.router.stats",        # RouterStats._lock: proxy counters
+    "serve.video.inflight",      # VideoEngine._inflight_lock: chunk admission
+    "serve.video.stats",         # VideoEngine._stats_lock: chunk counters
+    "serve.sessions.store",      # SessionStore._lock: flow-seed carry map
+    "serve.sessions.device",     # DeviceSessionStore._lock: device carry map
+    "analysis.guards.watch",     # RecompileWatch._slock: sanctioned windows
+    "analysis.guards.listener",  # guards._lock: one-time listener install
+    "resilience.watchdog.armed", # HangWatchdog._lock: armed-region tuple
+    "train.checkpoint.pending",  # checkpoint._LOCK: pending-flush registry
+    "data.loader.pool",          # _PoolManager._lock: decode-pool generation
+)
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock acquisition inverted the declared rank order, closed an
+    acquisition cycle (potential ABBA deadlock), or re-entered a
+    non-reentrant lock on its own thread. Raised at the offending
+    acquisition — before blocking — under strict mode."""
+
+
+class _Held:
+    """One entry of a thread's held-lock stack."""
+
+    __slots__ = ("lock", "depth", "t0")
+
+    def __init__(self, lock: "OrderedLock", depth: int, t0: float):
+        self.lock = lock
+        self.depth = depth
+        self.t0 = t0
+
+
+class LockRegistry:
+    """Process-wide acquisition graph + violation/contention accounting.
+
+    One module-level instance (:data:`REGISTRY`) serves the fleet;
+    tests construct private registries (with fake clocks and their own
+    strict flag) so seeded violations never pollute the global record
+    chaos_smoke asserts is clean.
+    """
+
+    VIOLATION_WINDOW = 32   # retained violation messages (stats blob)
+
+    def __init__(self, order: Sequence[str] = LOCK_ORDER, *,
+                 strict: Optional[bool] = None,
+                 held_warn_ms: float = 1000.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._rank: Dict[str, int] = {n: i for i, n in enumerate(order)}
+        # plain threading.Lock ON PURPOSE: the registry's own mutex must
+        # not feed the graph it guards, and it is never held across a
+        # blocking user-lock acquire
+        self._meta = threading.Lock()
+        self._edges: Dict[str, set] = {}          # held-name -> {acquired}
+        # (held, acquired) pairs already validated violation-free: the
+        # steady-state fast path checks this IMMUTABLE snapshot without
+        # _meta (replaced wholesale under _meta on growth), so hot
+        # nested acquisitions (chunk->stats per frame, inflight->stats
+        # per request) stop serializing on one global mutex after their
+        # first validation. Sound because the acquisition that CREATES
+        # a violation (the edge closing a cycle, the inverted rank) is
+        # by definition not yet in this set — skipping re-checks of
+        # clean edges can never skip the violating one.
+        self._clean_pairs: frozenset = frozenset()
+        self._locks: Dict[str, "weakref.WeakSet[OrderedLock]"] = {}
+        self._warned: set = set()                 # (kind, held, acquired)
+        self._violations: List[str] = []
+        self._tls = threading.local()
+        self.order_violations = 0
+        self.cycles = 0
+        self.strict = (os.environ.get("DEXIRAFT_LOCK_STRICT") == "1"
+                       if strict is None else bool(strict))
+        self.held_warn_ms = float(held_warn_ms)
+        self.clock = clock
+
+    # ---- bookkeeping -----------------------------------------------------
+
+    def rank(self, name: str) -> Optional[int]:
+        return self._rank.get(name)
+
+    def _held_stack(self) -> List[_Held]:
+        stack = getattr(self._tls, "held", None)
+        if stack is None:
+            stack = self._tls.held = []
+        return stack
+
+    def _register(self, lock: "OrderedLock") -> None:
+        with self._meta:
+            self._locks.setdefault(lock.name, weakref.WeakSet()).add(lock)
+
+    def _reaches(self, src: str, dst: str) -> Optional[List[str]]:
+        """BFS over the acquisition graph; the src->dst path if one
+        exists (meta lock held by the caller)."""
+        parents: Dict[str, Optional[str]] = {src: None}
+        frontier = [src]
+        while frontier:
+            nxt: List[str] = []
+            for node in frontier:
+                for succ in self._edges.get(node, ()):
+                    if succ in parents:
+                        continue
+                    parents[succ] = node
+                    if succ == dst:
+                        path = [dst]
+                        while parents[path[-1]] is not None:
+                            path.append(parents[path[-1]])
+                        return path[::-1]
+                    nxt.append(succ)
+            frontier = nxt
+        return None
+
+    # ---- the ordering check (nested acquisitions only) -------------------
+
+    def note_nested(self, lock: "OrderedLock",
+                    held: Sequence[_Held]) -> None:
+        """Record the held->lock edges and detect violations. Called
+        BEFORE blocking on `lock`, so a strict-mode raise names the
+        would-be deadlock instead of becoming one."""
+        problems: List[Tuple[str, str, str, str]] = []
+        clean: List[Tuple[str, str]] = []
+        with self._meta:
+            for entry in held:
+                h = entry.lock
+                if h.name == lock.name:
+                    # a DIFFERENT instance with the same name (same-
+                    # instance re-entry never reaches here): a total
+                    # order by name cannot order these, so two threads
+                    # nesting two instances in opposite orders is an
+                    # undetectable ABBA — flag the nesting itself
+                    self.order_violations += 1
+                    problems.append((
+                        "same-name-nesting", h.name, lock.name,
+                        f"two '{lock.name}' instances nested on one "
+                        f"thread — the name order cannot rank them, so "
+                        f"an opposite-order nesting elsewhere deadlocks "
+                        f"undetected; give the instances distinct "
+                        f"LOCK_ORDER names (or restructure to not "
+                        f"nest)"))
+                    continue
+                path = self._reaches(lock.name, h.name)
+                if path is not None:
+                    self.cycles += 1
+                    chain = " -> ".join(path + [lock.name])
+                    problems.append((
+                        "deadlock-cycle", h.name, lock.name,
+                        f"acquiring '{lock.name}' while holding "
+                        f"'{h.name}' closes the acquisition cycle "
+                        f"[{chain}] — another code path takes these "
+                        f"locks in the opposite order (ABBA deadlock)"))
+                elif (lock.rank is not None and h.rank is not None
+                        and lock.rank < h.rank):
+                    self.order_violations += 1
+                    problems.append((
+                        "rank-inversion", h.name, lock.name,
+                        f"'{lock.name}' (rank {lock.rank}) acquired "
+                        f"while holding '{h.name}' (rank {h.rank}) — "
+                        f"LOCK_ORDER declares the opposite nesting"))
+                else:
+                    clean.append((h.name, lock.name))
+                self._edges.setdefault(h.name, set()).add(lock.name)
+            if clean and not problems:
+                # promote the whole validated combination to the fast
+                # path (only when NO held pair misbehaved: a violating
+                # acquisition must keep being counted every time)
+                self._clean_pairs = self._clean_pairs.union(clean)
+            for _, _, _, msg in problems:
+                if len(self._violations) < self.VIOLATION_WINDOW:
+                    self._violations.append(msg)
+            fresh = [p for p in problems
+                     if (p[0], p[1], p[2]) not in self._warned]
+            self._warned.update((p[0], p[1], p[2]) for p in fresh)
+        if not problems:
+            return
+        if self.strict:
+            raise LockOrderViolation(
+                "; ".join(f"{p[0]}: {p[3]}" for p in problems))
+        for kind, _, _, msg in fresh:
+            print(f"[locks] {kind}: {msg} (warn-once; strict mode "
+                  f"raises here)", file=sys.stderr, flush=True)
+
+    # ---- stats -----------------------------------------------------------
+
+    def stats_record(self) -> dict:
+        """The ``locks`` stats block (serve /stats, chaos_smoke record):
+        violation verdicts plus per-lock contention/held gauges."""
+        with self._meta:
+            by_lock = {}
+            held_too_long = 0
+            for name in sorted(self._locks):
+                acq = cont = long = 0
+                max_ms = 0.0
+                for lk in self._locks[name]:
+                    acq += lk.acquisitions
+                    cont += lk.contended
+                    long += lk.held_too_long
+                    max_ms = max(max_ms, lk.max_held_ms)
+                held_too_long += long
+                if acq:
+                    by_lock[name] = {
+                        "acquisitions": acq,
+                        "contended": cont,
+                        "max_held_ms": round(max_ms, 3),
+                        "held_too_long": long,
+                    }
+            return {
+                "strict": self.strict,
+                "order_violations": self.order_violations,
+                "cycles": self.cycles,
+                "held_too_long": held_too_long,
+                "violations": list(self._violations),
+                "by_lock": by_lock,
+            }
+
+
+class OrderedLock:
+    """A named Lock/RLock that feeds the registry's lock-order graph.
+
+    Drop-in for ``threading.Lock()`` / ``threading.RLock()`` (with
+    ``reentrant=True``), including as the lock under a
+    ``threading.Condition`` — the ``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned`` protocol keeps the held-stack
+    bookkeeping correct across ``Condition.wait`` (waiting is not
+    holding, so a wait closes the current held span and opens a fresh
+    one on wake).
+
+    ``name`` should be declared in :data:`LOCK_ORDER`; an undeclared
+    name gets no rank (cycle detection still applies — test fixtures
+    and scratch locks stay usable) and threadlint's JL024 flags any
+    *nesting* of it in the fleet's source.
+    """
+
+    def __init__(self, name: str, *, reentrant: bool = False,
+                 registry: Optional[LockRegistry] = None):
+        self.name = name
+        self._reentrant = reentrant
+        self._registry = registry if registry is not None else REGISTRY
+        self.rank = self._registry.rank(name)
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        # gauges below are mutated ONLY while this lock is held (or on a
+        # failed non-blocking probe of an uncontended path — never), so
+        # they need no extra lock of their own
+        self.acquisitions = 0
+        self.contended = 0
+        self.max_held_ms = 0.0
+        self.total_held_ms = 0.0
+        self.held_too_long = 0
+        self._registry._register(self)
+
+    def __repr__(self) -> str:
+        return (f"OrderedLock({self.name!r}, rank={self.rank}, "
+                f"reentrant={self._reentrant})")
+
+    # ---- core API --------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        reg = self._registry
+        held = reg._held_stack()
+        for entry in held:
+            if entry.lock is self:
+                if self._reentrant:
+                    got = self._inner.acquire(blocking, timeout)
+                    if got:
+                        entry.depth += 1
+                    return got
+                if not blocking:
+                    # Condition's default _is_owned probes with
+                    # acquire(False): held-by-us must answer False,
+                    # not raise
+                    return False
+                raise LockOrderViolation(
+                    f"re-acquiring non-reentrant lock '{self.name}' on "
+                    f"the thread that already holds it — guaranteed "
+                    f"self-deadlock")
+        if held:
+            # fast path: a nested combination whose every (held, this)
+            # pair was already validated violation-free skips the
+            # registry mutex + graph walk entirely (an immutable-set
+            # read; see _clean_pairs). Anything new goes the slow way.
+            clean = reg._clean_pairs
+            if not all((e.lock.name, self.name) in clean for e in held):
+                # same-name pairs are never promoted to clean, so a
+                # second same-named instance always takes the slow path
+                # (where it is flagged as unorderable)
+                reg.note_nested(self, held)   # may raise under strict
+        waited = False
+        got = self._inner.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            waited = True
+            got = (self._inner.acquire(True) if timeout is None
+                   or timeout < 0 else self._inner.acquire(True, timeout))
+            if not got:
+                return False
+        t0 = reg.clock()
+        self.acquisitions += 1
+        if waited:
+            self.contended += 1
+        held.append(_Held(self, 1, t0))
+        return True
+
+    def release(self) -> None:
+        reg = self._registry
+        held = reg._held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            entry = held[i]
+            if entry.lock is self:
+                entry.depth -= 1
+                if entry.depth == 0:
+                    del held[i]
+                    self._note_span(entry.t0)
+                self._inner.release()
+                return
+        # a cross-thread release would free the inner lock but leave
+        # the acquirer's _Held entry stranded on ITS stack forever —
+        # every later acquisition on that thread would be checked
+        # against a phantom held lock (false violations) and the span
+        # gauge would never close. No fleet lock is handed off between
+        # threads, so make the misuse loud instead of corrupting the
+        # runtime's bookkeeping.
+        raise RuntimeError(
+            f"OrderedLock '{self.name}' released by a thread that does "
+            f"not hold it — cross-thread lock hand-off is not supported "
+            f"(use an Event/queue to transfer ownership)")
+
+    def _note_span(self, t0: float) -> None:
+        # still holding the lock here: gauge mutation is race-free
+        dt_ms = (self._registry.clock() - t0) * 1e3
+        self.total_held_ms += dt_ms
+        if dt_ms > self.max_held_ms:
+            self.max_held_ms = dt_ms
+        if dt_ms > self._registry.held_warn_ms:
+            self.held_too_long += 1
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        if self._reentrant:
+            # RLock has no .locked(), and a bare non-blocking probe
+            # would succeed REENTRANTLY for the owning thread (falsely
+            # answering "not locked" while it holds it) — check
+            # ownership first, probe only as the other-thread case
+            if (hasattr(self._inner, "_is_owned")
+                    and self._inner._is_owned()):
+                return True
+            if self._inner.acquire(False):
+                self._inner.release()
+                return False
+            return True
+        return self._inner.locked()
+
+    # ---- threading.Condition protocol ------------------------------------
+    # Condition.wait must FULLY release the lock (all recursion levels)
+    # and the held-stack entry with it: a waiting thread holds nothing.
+
+    def _is_owned(self) -> bool:
+        if self._reentrant and hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return any(e.lock is self for e in self._registry._held_stack())
+
+    def _release_save(self):
+        held = self._registry._held_stack()
+        depth = 1
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is self:
+                entry = held[i]
+                depth = entry.depth
+                del held[i]
+                self._note_span(entry.t0)
+                break
+        if self._reentrant and hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, saved) -> None:
+        state, depth = saved
+        if self._reentrant and hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._registry._held_stack().append(
+            _Held(self, depth, self._registry.clock()))
+
+
+#: The process-wide registry every fleet lock reports to.
+REGISTRY = LockRegistry()
+
+
+def set_strict(on: bool = True) -> None:
+    """Arm (or disarm) strict mode on the global registry: order
+    violations and deadlock cycles raise at the offending acquisition.
+    Wired behind ``--strict`` serving and armed for the whole test
+    suite (tests/conftest.py) — the lock-order analog of the fsdp
+    replication canary."""
+    REGISTRY.strict = bool(on)
+
+
+def stats_record() -> dict:
+    """The global registry's ``locks`` stats block."""
+    return REGISTRY.stats_record()

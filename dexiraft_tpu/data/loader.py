@@ -34,6 +34,8 @@ from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
+from dexiraft_tpu.analysis.locks import OrderedLock
+
 Batch = Dict[str, np.ndarray]
 
 
@@ -157,14 +159,18 @@ class _PoolManager:
 
     def __init__(self, loader: "Loader"):
         self.loader = loader
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("data.loader.pool")
         self._generation = 0
         self._rebuilds_since_success = 0
         self._closed = False
         self._pool = self._build()
 
     def note_success(self) -> None:
-        self._rebuilds_since_success = 0
+        with self._lock:
+            # unlocked, this reset can interleave with rebuild()'s
+            # locked increment and resurrect a stale streak count —
+            # the give-up ceiling then fires early (or never)
+            self._rebuilds_since_success = 0
 
     def _build(self):
         ld = self.loader

@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from dexiraft_tpu.analysis.locks import OrderedLock
+
 STALL_EXIT_CODE = 98
 
 
@@ -69,7 +71,7 @@ class HangWatchdog:
         self.ewma_s: Optional[float] = None
         self.fired = False
         self.straggler_warnings = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("resilience.watchdog.armed")
         self._armed: Optional[tuple] = None  # (step, region, t0, warned)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -130,11 +132,15 @@ class HangWatchdog:
         dt = self._clock() - t0
         if not (feed_ewma and steady):
             return dt
-        if self.ewma_s is None:
-            self.ewma_s = dt
-        else:
-            a = self.ewma_alpha
-            self.ewma_s = (1 - a) * self.ewma_s + a * dt
+        with self._lock:
+            # under the lock: the monitor thread reads ewma_s for the
+            # straggler floor every poll, and an unlocked read-blend-
+            # write here can resurrect a stale EWMA over a fresh one
+            if self.ewma_s is None:
+                self.ewma_s = dt
+            else:
+                a = self.ewma_alpha
+                self.ewma_s = (1 - a) * self.ewma_s + a * dt
         return dt
 
     # -- monitor -----------------------------------------------------------

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.serve.router import Router, RouterConfig
 
 
@@ -144,40 +145,70 @@ class _Supervisor:
         self.restarts: Dict[str, int] = {}
         self._last_restart: Dict[str, float] = {}
         self._gave_up: set = set()
-        self._lock = threading.Lock()
+        self._respawning: set = set()
+        self._lock = OrderedLock("serve.router.supervisor")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def spawn_all(self) -> Dict[str, str]:
         urls = {}
-        for i in range(self.args.spawn):
-            rid = f"r{i}"
-            port = self.args.port_base + i
-            self.ports[rid] = port
-            self.restarts[rid] = 0
-            self.procs[rid] = spawn_replica(port, self.serve_args,
-                                            host=self.args.host)
-            urls[rid] = f"{self.args.host}:{port}"
+        with self._lock:
+            # startup runs before _watch exists, but the drain hook can
+            # already be wired — keep every procs/ports mutation under
+            # the one lock the other writers hold (threadlint JL021)
+            for i in range(self.args.spawn):
+                rid = f"r{i}"
+                port = self.args.port_base + i
+                self.ports[rid] = port
+                self.restarts[rid] = 0
+                self.procs[rid] = spawn_replica(port, self.serve_args,
+                                                host=self.args.host)
+                urls[rid] = f"{self.args.host}:{port}"
         return urls
 
     def respawn(self, rid: str) -> None:
         """The drain hook: SIGTERM (replica drains itself — zero-drop),
         reap, spawn fresh. Called with the replica already out of
-        assignment and at 0 in-flight."""
+        assignment and at 0 in-flight. Idempotent under concurrent
+        drains of the same rid: the loser of the latch race returns and
+        lets the in-flight respawn finish."""
         with self._lock:
+            if rid in self._respawning:
+                # a second drain of the same replica while the first is
+                # still reaping: both would reap the same old child and
+                # then BOTH spawn onto the same port (one live orphan +
+                # procs[rid] pointing at the bind-race loser)
+                return
+            # _respawning is ALSO the watcher-suppression latch: _watch
+            # skips respawning rids in both its dead-sweep and its
+            # backoff-spawn guard, so the watcher cannot double-spawn
+            # onto the port while we reap below with no lock held. The
+            # latch is self-clearing in the finally — a failed spawn
+            # returns the rid to the watcher's care (crash-restart with
+            # backoff) instead of stranding it.
+            self._respawning.add(rid)
             proc = self.procs.get(rid)
+        try:
             if proc is not None and proc.poll() is None:
+                # reap OUTSIDE the lock: a drain-wait can take up to
+                # 60s, and holding the supervisor lock across it would
+                # stall the crash-restart sweep for every OTHER replica
+                # (JL023)
                 proc.terminate()
                 try:
                     proc.wait(timeout=60.0)
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait()
-            self.procs[rid] = spawn_replica(self.ports[rid],
-                                            self.serve_args,
-                                            host=self.args.host)
-            self.restarts[rid] = 0    # deliberate restart, not a crash
-            self._gave_up.discard(rid)
+            with self._lock:
+                self.procs[rid] = spawn_replica(self.ports[rid],
+                                                self.serve_args,
+                                                host=self.args.host)
+                self.restarts[rid] = 0  # deliberate restart, not a crash
+                self._gave_up.discard(rid)   # a drain respawn revives
+        finally:
+            with self._lock:
+                self._respawning.discard(rid)
         print(f"[router] replica {rid} drained and respawned on port "
               f"{self.ports[rid]}", flush=True)
 
@@ -190,7 +221,9 @@ class _Supervisor:
             with self._lock:
                 dead = [(rid, p, p.returncode)
                         for rid, p in self.procs.items()
-                        if p.poll() is not None and rid not in self._gave_up]
+                        if p.poll() is not None
+                        and rid not in self._gave_up
+                        and rid not in self._respawning]
                 # a replica that stayed up past the reset window ended
                 # its crash STREAK: its restart budget refills (the cap
                 # bounds consecutive failures, not lifetime restarts)
@@ -204,7 +237,8 @@ class _Supervisor:
                 if n >= self.args.max_restarts:
                     # latch: one give-up line, not one per sweep; a
                     # drain-hook respawn un-latches it
-                    self._gave_up.add(rid)
+                    with self._lock:
+                        self._gave_up.add(rid)
                     print(f"[router] replica {rid} exited rc={rc}; "
                           f"{n} consecutive restarts already — giving up "
                           f"on it (breaker keeps it out of routing; "
@@ -222,10 +256,13 @@ class _Supervisor:
                 with self._lock:
                     if self._stop.is_set():
                         return
-                    if self.procs[rid] is not proc or proc.poll() is None:
-                        # someone (the drain hook) already replaced it
-                        # during the backoff — spawning again would
-                        # double-bind the port and orphan the live child
+                    if (self.procs[rid] is not proc
+                            or proc.poll() is None
+                            or rid in self._respawning):
+                        # someone (the drain hook) already replaced it —
+                        # or is mid-respawn right now — spawning again
+                        # would double-bind the port and orphan the
+                        # live child
                         continue
                     self.restarts[rid] += 1
                     self._last_restart[rid] = time.monotonic()

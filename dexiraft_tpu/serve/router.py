@@ -73,6 +73,8 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from dexiraft_tpu.analysis import locks as _locks
+from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.serve.httputil import QuietDisconnectsMixin
 
 # breaker states
@@ -249,7 +251,8 @@ class ReplicaPool:
         self.clock = clock
         self.sleep = sleep
         self.prober = prober or self._http_probe
-        self._lock = threading.RLock()
+        # reentrant: record() re-enters via affinity_record()
+        self._lock = OrderedLock("serve.router.pool", reentrant=True)
         self.replicas: Dict[str, Replica] = {
             rid: Replica(rid, url) for rid, url in replicas.items()}
         self.ring = HashRing(sorted(self.replicas),
@@ -515,7 +518,7 @@ class RouterStats:
     and chaos phase report as results."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.router.stats")
         self.reset()
 
     def reset(self) -> None:
@@ -711,11 +714,20 @@ class Router:
         for rid, hook in (restarts or {}).items():
             self.pool.replicas[rid].restart = hook
         self.stats = RouterStats()
+        # the autoscale window's since-last-scrape snapshot: /stats can
+        # be scraped concurrently (operator curl + the bench + a second
+        # router probe), and an unlocked read-swap would hand two
+        # scrapes overlapping windows — double-counting shed into two
+        # scale_up verdicts. Ranked above pool/stats: the whole
+        # counters-read + prev-swap runs under it as one window
+        self._autoscale_lock = OrderedLock("serve.router.autoscale")
         self._autoscale_prev = {"requests": 0, "shed": 0}
         self.clock = clock
         self._rng = rng or random.Random(0)
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        # ranked before the stats lock: proxy_flow bumps counters while
+        # holding the admission bound
+        self._inflight_lock = OrderedLock("serve.router.inflight")
         self._httpd = _RouterHTTPServer((host, port), _RouterHandler,
                                         router=self)
         self._http_thread: Optional[threading.Thread] = None
@@ -877,13 +889,20 @@ class Router:
         Recommendation: UP when anything shed this window or every
         routable replica is carrying queued work; DOWN when >1 replica
         is routable and the window was idle; else steady."""
-        pool_rec = self.pool.record()
-        st = self.stats.record()
-        cur = {"requests": st["requests"],
-               "shed": (st["shed_router"] + st["shed_upstream"]
-                        + st["no_healthy"])}
-        prev = self._autoscale_prev
-        self._autoscale_prev = cur
+        with self._autoscale_lock:
+            # read-and-swap is ONE atomic window: computing `cur`
+            # outside the lock lets two concurrent scrapes swap
+            # snapshots out of order (an older cur stored as prev
+            # double-counts the newer scrape's window). The autoscale
+            # lock ranks ABOVE pool/stats in LOCK_ORDER precisely so
+            # these record() calls may nest under it
+            pool_rec = self.pool.record()
+            st = self.stats.record()
+            cur = {"requests": st["requests"],
+                   "shed": (st["shed_router"] + st["shed_upstream"]
+                            + st["no_healthy"])}
+            prev = self._autoscale_prev
+            self._autoscale_prev = cur
         # counters only move forward except across reset_stats(); a
         # negative delta means a reset — the window restarts at cur
         d_req = (cur["requests"] - prev["requests"]
@@ -914,6 +933,11 @@ class Router:
             "router": self.stats.record(),
             "pool": self.pool.record(),
             "autoscale": self._autoscale_record(),
+            # lock-order runtime verdicts + contention gauges for the
+            # router's own thread fabric (handler threads, health loop,
+            # drain threads) — the chaos failover phase pins the
+            # violation counters at 0
+            "locks": _locks.stats_record(),
         }
         if scrape_replicas:
             scraped = {}
@@ -935,7 +959,8 @@ class Router:
     def reset_stats(self) -> None:
         self.stats.reset()
         self.pool.reset_counters()
-        self._autoscale_prev = {"requests": 0, "shed": 0}
+        with self._autoscale_lock:
+            self._autoscale_prev = {"requests": 0, "shed": 0}
 
     # ---- lifecycle ------------------------------------------------------
 

@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.serve.buckets import bucket_shape
 from dexiraft_tpu.serve.engine import InferenceEngine, Result
 
@@ -157,7 +158,13 @@ class Scheduler:
         # a guarantee that no other dispatch is concurrent
         self.post_dispatch: Optional[
             Callable[[Tuple[int, int], List[Result]], None]] = None
-        self._cv = threading.Condition()
+        # the condition's lock is a named, REENTRANT OrderedLock: the
+        # quiesced /stats snapshot re-enters it (run_quiesced ->
+        # stats_record), and naming it puts every queue-lock nesting
+        # (cv -> sessions/video stats in the service's quiesced reset)
+        # under the declared LOCK_ORDER
+        self._cv = threading.Condition(
+            OrderedLock("serve.scheduler.cv", reentrant=True))
         self._running = False        # dispatcher currently inside _run()
         self._quiesce_waiters = 0    # run_quiesced() callers pending
         self._queues: Dict[Tuple[int, int], "collections.deque[_Request]"] \
@@ -294,21 +301,29 @@ class Scheduler:
     def _run_inner(self, bucket: Tuple[int, int], group: List[_Request],
                    full: bool) -> None:
         st = self.stats
-        if full:
-            st.dispatch_full += 1
-        elif self._draining or self._closed:
-            st.dispatch_drain += 1
-        else:
-            st.dispatch_slo += 1
-        st.batch_fill += len(group)
         t0 = self.clock()
-        for r in group:
-            st.wait_s.append(t0 - r.t_submit)
+        # counter bumps take the cv: handler threads mutate the same
+        # SchedulerStats under it (submit/reject paths) and /stats reads
+        # it — a bare dispatcher-side += is the RouterStats undercount
+        # bug (threadlint JL021). The ENGINE call below stays outside
+        # the lock: blocking a whole batch's device time under the cv
+        # would stall every submit (JL023).
+        with self._cv:
+            if full:
+                st.dispatch_full += 1
+            elif self._draining or self._closed:
+                st.dispatch_drain += 1
+            else:
+                st.dispatch_slo += 1
+            st.batch_fill += len(group)
+            for r in group:
+                st.wait_s.append(t0 - r.t_submit)
         compile0 = self.engine.compile_s
         try:
             results = self.engine.run_batch([r.item for r in group])
         except Exception as e:
-            st.failed += len(group)
+            with self._cv:
+                st.failed += len(group)
             for r in group:
                 r.error = e
                 r.event.set()
@@ -319,9 +334,10 @@ class Scheduler:
         # for the rest of the process life
         dt = (self.clock() - t0
               - max(0.0, self.engine.compile_s - compile0))
-        prev = self._service_s.get(bucket)
-        self._service_s[bucket] = (dt if prev is None
-                                   else (1 - _EWMA) * prev + _EWMA * dt)
+        with self._cv:
+            prev = self._service_s.get(bucket)
+            self._service_s[bucket] = (dt if prev is None
+                                       else (1 - _EWMA) * prev + _EWMA * dt)
         if self.post_dispatch is not None:
             # BEFORE the events fire: a waiter acting on its result
             # (e.g. the server's carry splat) must find whatever this
@@ -332,11 +348,13 @@ class Scheduler:
                 print(f"[scheduler] post_dispatch hook failed: "
                       f"{type(e).__name__}: {e}", flush=True)
         now = self.clock()
+        with self._cv:
+            for r in group:
+                st.latency_s.append(now - r.t_submit)
+            st.completed += len(group)
         for r, res in zip(group, results):
-            st.latency_s.append(now - r.t_submit)
             r.result = res
             r.event.set()
-        st.completed += len(group)
 
     def _loop(self) -> None:
         while True:
@@ -422,8 +440,12 @@ class Scheduler:
             inflight = self._pending + self._dispatched
             ests = {f"{h}x{w}": round(s * 1e3, 2)
                     for (h, w), s in sorted(self._service_s.items())}
+            # counters snapshot under the same lock their writers hold
+            # (submit paths and the dispatcher's bumps): no torn
+            # completed-vs-latency combinations in a scrape
+            counters = self.stats.record()
         return {
-            **self.stats.record(),
+            **counters,
             "queue_depth": depth,
             "inflight": inflight,
             "slo_ms": round(self.slo_s * 1e3, 2),

@@ -69,6 +69,8 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from dexiraft_tpu.analysis import locks
+from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.serve.buckets import bucket_shape
 from dexiraft_tpu.serve.engine import InferenceEngine
 from dexiraft_tpu.serve.httputil import QuietDisconnectsMixin
@@ -437,7 +439,9 @@ class FlowService:
         self._http_thread: Optional[threading.Thread] = None
         self._t0 = clock()
         self._signal_latched = False
-        self._stop_lock = threading.Lock()
+        # ranked ABOVE the scheduler cv in LOCK_ORDER: drain_and_stop
+        # holds it across scheduler.drain()/close(), which take the cv
+        self._stop_lock = OrderedLock("serve.server.stop")
         self.stopped = threading.Event()
 
     # ---- introspection -------------------------------------------------
@@ -493,6 +497,11 @@ class FlowService:
                          if self.sessions is not None else None),
             "video": (self.video.stats_record()
                       if self.video is not None else None),
+            # the lock-order runtime's verdict block (analysis/locks):
+            # order violations / deadlock cycles must read 0 on a
+            # healthy replica; contention + max-held-ms surface the
+            # lock hot spots a latency investigation needs
+            "locks": locks.stats_record(),
         }
 
     def _post_dispatch(self, bucket, results) -> None:

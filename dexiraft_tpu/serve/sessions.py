@@ -38,10 +38,11 @@ admission; see its docstring for the math).
 from __future__ import annotations
 
 import collections
-import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+from dexiraft_tpu.analysis.locks import OrderedLock
 
 
 class _Entry:
@@ -70,7 +71,7 @@ class SessionStore:
         self.ttl_s = ttl_s
         self.max_sessions = max_sessions
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.sessions.store")
         # insertion order == recency order (move_to_end on touch)
         self._entries: "collections.OrderedDict[str, _Entry]" = \
             collections.OrderedDict()
@@ -234,7 +235,7 @@ class DeviceSessionStore:
         self.ttl_s = ttl_s
         self.max_sessions = max_sessions
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.sessions.device")
         self._entries: "collections.OrderedDict[str, _DeviceEntry]" = \
             collections.OrderedDict()
         self.bytes_in_use = 0

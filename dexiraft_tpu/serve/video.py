@@ -44,12 +44,12 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.data.padder import InputPadder
 from dexiraft_tpu.serve.buckets import bucket_shape
 from dexiraft_tpu.serve.sessions import DeviceSessionStore
@@ -138,16 +138,19 @@ class VideoEngine:
 
             watch = RecompileWatch("video")
         self.watch = watch
-        self._lock = threading.Lock()
+        # named + rank-ordered (analysis/locks.py LOCK_ORDER): the chunk
+        # lock is the fleet's outermost — a chunk's frame loop nests the
+        # stats lock, the device session store, and the shared watch
+        self._lock = OrderedLock("serve.video.chunk")
         # chunks admitted but unanswered (waiting on _lock OR mid-loop):
         # the router's zero-drop drain polls /healthz inflight to 0, so
         # streaming work must count there like scheduler.inflight()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = OrderedLock("serve.video.inflight")
         self._inflight = 0
         # counters/latency get their OWN lock: _lock is held for a whole
         # chunk's frame loop, and a /stats scrape must not stall behind
         # one live chunk
-        self._stats_lock = threading.Lock()
+        self._stats_lock = OrderedLock("serve.video.stats")
         self._compiled: set = set()
         self._zero_fi: Dict[Tuple[int, ...], Any] = {}
         # counters (reset via reset_stats; surfaced on /stats)

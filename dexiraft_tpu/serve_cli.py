@@ -337,6 +337,14 @@ def _serve_one(args) -> None:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if args.strict:
+        # --strict arms BOTH runtime sentinels: the recompile watch
+        # (engine/video strict checks) and the lock-order runtime —
+        # a rank inversion or ABBA cycle in the serve thread fabric
+        # raises at the offending acquisition instead of warning
+        from dexiraft_tpu.analysis import locks
+
+        locks.set_strict(True)
     if not args.no_compile_cache:
         from dexiraft_tpu.profiling import enable_persistent_cache
 

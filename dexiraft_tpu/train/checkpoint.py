@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.train.state import TrainState
 
 
@@ -61,7 +62,10 @@ _MANAGERS: "dict[str, ocp.CheckpointManager]" = {}
 _PENDING: "dict[str, dict]" = {}
 _STATS: "dict[str, dict]" = {}
 _EXECUTOR: Optional[ThreadPoolExecutor] = None
-_LOCK = threading.Lock()
+# guards the pending/stats registries only — never held across a flush
+# wait (wait_pending pops under the lock, then blocks on the future
+# outside it; the flush thread itself never touches this lock)
+_LOCK = OrderedLock("train.checkpoint.pending")
 
 # --- test/chaos seams (resilience.chaos, tests/test_zzresilience.py) -----
 # flush_hold: when set to an Event, the background flush waits on it

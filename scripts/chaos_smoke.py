@@ -42,7 +42,16 @@ Phases:
 
 The last stdout line is a JSON record with per-phase recovery
 wall-times (`[chaos] record {...}` — RECORD_KEYS pins the schema), so
-recovery-latency regressions are visible run-over-run in the logs.
+recovery-latency regressions are visible run-over-run in the logs. The
+record also carries the lock-order runtime's verdict (analysis/locks):
+the kill-mid-flush and router-failover phases assert — and pin into
+their record entries — ZERO lock-order violations and ZERO deadlock
+cycles while their thread fabric was under fire, so the concurrency
+gate holds under the exact chaos it exists for, not just in unit tests.
+The smoke also runs `lint_gate.py --json` up front (the machine-
+readable contract, no stdout scraping) and pins the static gate's
+verdict alongside — one record answers both halves of the concurrency
+story: the tree lints clean AND the runtime observed no violations.
 """
 
 from __future__ import annotations
@@ -61,9 +70,38 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
 
-# JSON-tail schema: per-phase {ok, wall_s} plus totals
-RECORD_KEYS = ("phases", "failures", "total_s")
+# JSON-tail schema: per-phase {ok, wall_s} plus totals; the locks block
+# is the lock-order runtime's verdict (analysis/locks.py) — the
+# kill-mid-flush and router-failover phases additionally pin a
+# per-phase snapshot proving ZERO order violations / deadlock cycles
+# were observed while their thread fabric was under fire
+RECORD_KEYS = ("phases", "failures", "total_s", "locks", "lint_gate")
+# every phase entry carries at least these keys ...
 PHASE_KEYS = ("ok", "wall_s")
+# ... and the two concurrency-gate phases (kill-mid-flush,
+# router-failover) additionally merge this key — their per-phase
+# lock-order snapshot
+PHASE_LOCKS_KEY = "locks"
+
+
+def _locks_verdict(phase: str) -> dict:
+    """Assert the lock-order runtime saw no violations, and return the
+    snapshot for the phase's record entry. In-process the smoke drives
+    the REAL router/checkpoint thread fabric (handler threads, health
+    loop, drain threads, the flush barrier), so a nonzero count here is
+    a concurrency regression even when the phase's recovery contract
+    still held."""
+    from dexiraft_tpu.analysis import locks
+
+    rec = locks.stats_record()
+    assert rec["order_violations"] == 0, \
+        f"{phase}: lock-order violations under fire: {rec['violations']}"
+    assert rec["cycles"] == 0, \
+        f"{phase}: deadlock cycles detected under fire: {rec['violations']}"
+    return {"locks": {"order_violations": rec["order_violations"],
+                      "cycles": rec["cycles"],
+                      "contended": sum(v["contended"]
+                                       for v in rec["by_lock"].values())}}
 
 
 def _build_chairs_tree(tmp: str, n: int = 8) -> None:
@@ -207,7 +245,7 @@ def _train_subprocess(tmp: str, cli_args, expect_rc: int,
     return out
 
 
-def phase_kill_mid_flush(tmp: str) -> None:
+def phase_kill_mid_flush(tmp: str) -> dict:
     import jax
 
     from dexiraft_tpu.config import TrainConfig, raft_v1
@@ -239,6 +277,9 @@ def phase_kill_mid_flush(tmp: str) -> None:
     print(f"    killed mid-flush of step 4 (debris: {len(debris)} tmp "
           f"dir(s)) -> restore_verified landed on step {got}; --resume "
           f"completed to step 6")
+    # the in-process half (restore_verified + the wait_pending barriers
+    # above) ran the flush-lock fabric: pin zero order violations
+    return _locks_verdict("kill-mid-flush")
 
 
 def phase_multihost_kill(tmp: str) -> None:
@@ -294,7 +335,7 @@ def phase_multihost_kill(tmp: str) -> None:
           f"uninterrupted pair")
 
 
-def phase_router_failover(tmp: str) -> None:
+def phase_router_failover(tmp: str) -> dict:
     """Kill 1 of 2 replicas behind the fleet router under closed-loop
     session load. Recovery contract: zero accepted requests dropped
     (router failover + client connection-retry absorb the death), the
@@ -384,6 +425,11 @@ def phase_router_failover(tmp: str) -> None:
               f"{rec['failovers']} router failovers), affinity "
               f"{aff_before['hit_rate']} -> {aff_after['hit_rate']} "
               f"({aff_after['sticky_misses']} sticky misses)")
+        # the router ran IN-PROCESS with its full thread fabric
+        # (handler threads x4 clients, health loop, passive breaker
+        # marking) while a replica died under it: pin zero lock-order
+        # violations across the failover
+        return _locks_verdict("router-failover")
     finally:
         if router is not None:
             router.stop()
@@ -398,10 +444,41 @@ def phase_router_failover(tmp: str) -> None:
                 p.wait()
 
 
+def _lint_gate_verdict(failures: list) -> dict:
+    """Run the static gate through its --json contract (no stdout
+    scraping): the smoke's recovery phases prove the RUNTIME lock
+    discipline holds under fire; this pins that the STATIC half
+    (threadlint JL020+ with the rest of jaxlint) is clean on the same
+    tree, in the same record."""
+    gate = osp.join(osp.dirname(osp.abspath(__file__)), "lint_gate.py")
+    proc = subprocess.run([sys.executable, gate, "--json"],
+                          capture_output=True, text=True, timeout=120)
+    try:
+        blob = json.loads(proc.stdout)
+    except ValueError:
+        print(f"[chaos] lint gate emitted unparseable --json output "
+              f"(rc {proc.returncode}):\n{proc.stdout[-1000:]}",
+              flush=True)
+        failures.append("lint-gate")
+        return {"ok": False, "findings": None}
+    verdict = {"ok": blob["ok"], "findings": len(blob["findings"]),
+               "per_rule": {r: c["findings"]
+                            for r, c in blob["per_rule"].items()
+                            if c["findings"]}}
+    if not blob["ok"]:
+        print(f"[chaos] lint gate FAIL: {verdict}", flush=True)
+        failures.append("lint-gate")
+    else:
+        print(f"[chaos] lint gate clean ({blob['files']} files)",
+              flush=True)
+    return verdict
+
+
 def main() -> int:
     t_start = time.perf_counter()
     failures = []
     record: dict = {}
+    gate_verdict = _lint_gate_verdict(failures)
     with tempfile.TemporaryDirectory() as tmp:
         _build_chairs_tree(tmp)
         os.environ["DEXIRAFT_DATA_DIR"] = tmp
@@ -420,8 +497,9 @@ def main() -> int:
             for name, fn in phases:
                 t0 = time.perf_counter()
                 print(f"[chaos] {name} ...", flush=True)
+                extra: dict = {}
                 try:
-                    fn()
+                    extra = fn() or {}
                     ok = True
                     print(f"[chaos] {name} PASS "
                           f"({time.perf_counter() - t0:.1f}s)", flush=True)
@@ -431,10 +509,11 @@ def main() -> int:
                     print(f"[chaos] {name} FAIL", flush=True)
                     failures.append(name)
                 # per-phase recovery wall-time: the run-over-run signal
-                # for recovery-latency regressions
+                # for recovery-latency regressions (+ the locks verdict
+                # the concurrency-gate phases pin)
                 record[name] = {"ok": ok,
                                 "wall_s": round(time.perf_counter() - t0,
-                                                1)}
+                                                1), **extra}
         finally:
             os.chdir(cwd)
     total = time.perf_counter() - t_start
@@ -443,9 +522,19 @@ def main() -> int:
     else:
         print(f"[chaos] all {len(phases)} recovery paths recovered "
               f"({total:.1f}s)")
+    from dexiraft_tpu.analysis import locks
+
+    lrec = locks.stats_record()
     print("[chaos] record " + json.dumps(
         {"phases": record, "failures": failures,
-         "total_s": round(total, 1)}, sort_keys=True), flush=True)
+         "total_s": round(total, 1),
+         # the whole smoke's lock-order verdict: every in-process
+         # phase's thread fabric, one line, greppable run-over-run
+         "locks": {"order_violations": lrec["order_violations"],
+                   "cycles": lrec["cycles"],
+                   "held_too_long": lrec["held_too_long"]},
+         "lint_gate": gate_verdict},
+        sort_keys=True), flush=True)
     return 1 if failures else 0
 
 

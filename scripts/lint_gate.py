@@ -18,6 +18,13 @@ Usage:
   python scripts/lint_gate.py --stats         # per-rule finding/allowlist
                                               # counts (rule-set growth
                                               # stays observable)
+  python scripts/lint_gate.py --json          # machine-readable verdict:
+                                              # findings + per-rule counts
+                                              # as one JSON object (CI and
+                                              # chaos_smoke consume this
+                                              # instead of scraping
+                                              # stdout); exit code
+                                              # semantics unchanged
   python scripts/lint_gate.py --list-rules
   python scripts/lint_gate.py path/to/file.py # lint specific files
 
@@ -65,6 +72,10 @@ def main(argv=None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="print per-rule finding/allowlist counts after "
                          "the gate verdict")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the whole verdict (findings, stale "
+                         "entries, per-rule counts) as one JSON object "
+                         "on stdout; exit code semantics unchanged")
     args = ap.parse_args(argv)
 
     jl = _load_jaxlint()
@@ -101,6 +112,9 @@ def main(argv=None) -> int:
         print(json.dumps([f.baseline_entry() for f in kept], indent=2))
         return 0 if not kept else 1
 
+    if args.json:
+        return _emit_json(jl, baseline, kept, allowed, stale, stats)
+
     for f in kept:
         print(f)
     for e in stale:
@@ -120,6 +134,42 @@ def main(argv=None) -> int:
           f"{'' if ok else ' — FAIL'}")
     if args.stats:
         _print_stats(jl, baseline, kept, allowed)
+    return 0 if ok else 1
+
+
+def _emit_json(jl, baseline, kept, allowed, stale, stats) -> int:
+    """The --json verdict: everything the text mode prints, as one
+    parseable object. ``ok`` mirrors the exit code (0 iff ok) so a
+    consumer never has to reconcile two verdicts."""
+    from collections import Counter
+
+    n_kept = Counter(f.rule for f in kept)
+    n_allowed = Counter(f.rule for f in allowed)
+    n_entries = Counter(e.get("rule") for e in
+                        (baseline.allow if baseline else []))
+    stale_ex = stats.get("stale_excludes", [])
+    missing = stats.get("missing_scope", [])
+    ok = not kept and not stale and not stale_ex and not missing
+    blob = {
+        "ok": ok,
+        "files": stats["files"],
+        "excluded": stats["excluded"],
+        "findings": [
+            {"rule": f.rule, "name": jl.RULES[f.rule], "path": f.path,
+             "line": f.line, "col": f.col, "message": f.message,
+             "snippet": f.snippet}
+            for f in kept],
+        "allowlisted": len(allowed),
+        "stale_allow": list(stale),
+        "stale_excludes": list(stale_ex),
+        "missing_scope": list(missing),
+        "per_rule": {
+            rule: {"findings": n_kept[rule], "allowlisted": n_allowed[rule],
+                   "baseline_entries": n_entries[rule]}
+            for rule in sorted(jl.RULES)
+            if n_kept[rule] or n_allowed[rule] or n_entries[rule]},
+    }
+    print(json.dumps(blob, indent=2, sort_keys=True))
     return 0 if ok else 1
 
 

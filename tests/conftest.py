@@ -35,6 +35,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# arm the lock-order runtime for the whole suite (analysis/locks): any
+# rank inversion or ABBA acquisition cycle in the serve/resilience
+# thread fabric RAISES at the offending acquisition instead of warning
+# — every threaded tier-1 test doubles as a lock-discipline canary
+# (the armed-replication-canary idiom). Seeded-violation tests use
+# private LockRegistry instances, so the global registry stays clean.
+from dexiraft_tpu.analysis import locks as _locks  # noqa: E402
+
+_locks.set_strict(True)
+
 DURATIONS_PATH = osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
                           "logs", "test_durations.json")
 CEILING_S = float(os.environ.get("DEXIRAFT_TEST_CEILING_S", "420"))

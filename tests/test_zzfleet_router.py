@@ -431,7 +431,11 @@ def test_router_stats_schema_pinned():
     router = make_router()
     try:
         rec = router.stats_record()
-        assert set(rec) == {"router", "pool", "autoscale"}
+        assert set(rec) == {"router", "pool", "autoscale", "locks"}
+        # the lock-order runtime's verdict block: a healthy router
+        # reads zero violations (tests run with strict armed anyway)
+        assert rec["locks"]["order_violations"] == 0
+        assert rec["locks"]["cycles"] == 0
         assert set(rec["router"]) == ROUTER_KEYS
         assert set(rec["pool"]) == POOL_KEYS
         assert set(rec["pool"]["affinity"]) == AFFINITY_KEYS

@@ -321,7 +321,12 @@ class TestHTTPService:
 
         status, stats = _get_json(service.url, "/stats?reset=1")
         assert set(stats) == {"service", "engine", "scheduler", "sessions",
-                              "video"}
+                              "video", "locks"}
+        # lock-order runtime verdicts (analysis/locks): a healthy
+        # replica serves with zero violations — strict mode is armed
+        # suite-wide, so a nonzero here would have raised upstream
+        assert stats["locks"]["order_violations"] == 0
+        assert stats["locks"]["cycles"] == 0
         assert set(stats["service"]) == {
             "uptime_s", "draining", "slo_ms", "sessions_enabled"}
         # engine blob: ServeStats + registry, incl. the bucket SHAPES

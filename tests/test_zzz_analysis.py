@@ -445,6 +445,26 @@ class TestGuards:
         jax.jit(lambda x: x / 9)(jnp.ones((17,)))  # unplanned: counted
         assert watch.drift >= 1
 
+    def test_overlapping_sanctioned_windows_absorb_once(self):
+        """Two open windows sharing one watch (both engines compiling
+        fresh buckets at once) must shift the baseline by the UNION
+        span's compiles, not once per window — a double shift drives
+        drift negative and silently swallows the next real retraces."""
+        import jax
+        import jax.numpy as jnp
+
+        from dexiraft_tpu.analysis import guards
+
+        watch = guards.RecompileWatch("fixture")
+        watch.mark_warm()
+        with watch.sanctioned():
+            with watch.sanctioned():
+                jax.jit(lambda x: x / 17)(jnp.ones((29,)))
+        assert watch.drift == 0      # absorbed once — NOT -1
+        jax.jit(lambda x: x / 19)(jnp.ones((31,)))
+        assert watch.drift >= 1      # the next unplanned compile counts
+                                     # (a double shift would swallow it)
+
     def test_check_defers_while_sanctioned_window_open(self):
         """The serve-tier race: the pair dispatcher and the streaming
         engine share ONE watch across threads — a check() landing while

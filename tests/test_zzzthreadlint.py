@@ -1,0 +1,808 @@
+"""threadlint (JL020+) + OrderedLock lock-order runtime coverage.
+
+One positive + one negative fixture per lock-discipline rule (incl.
+suppression and lock-attr discovery), the OrderedLock runtime's
+order-graph / cycle / rank-inversion / held-too-long semantics on a
+fake clock, a seeded two-lock ABBA cycle caught at the SECOND
+acquisition (not by timeout), the /stats ``locks``-block schema pin,
+and the static-mirror == runtime-registry pin for LOCK_ORDER.
+
+Named zzz to sort LAST (tier-1 budget convention); everything here is
+pure-stdlib lock plumbing + AST fixtures — target well under 5 s.
+"""
+
+from __future__ import annotations
+
+import os.path as osp
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from dexiraft_tpu.analysis import jaxlint, locks, threadlint
+from dexiraft_tpu.analysis.locks import (LockOrderViolation, LockRegistry,
+                                         OrderedLock)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+GATE = osp.join(REPO, "scripts", "lint_gate.py")
+
+
+def rules_of(src: str, path: str = "dexiraft_tpu/serve/fixture.py"):
+    return {f.rule for f in jaxlint.lint_source(textwrap.dedent(src), path)}
+
+
+# --------------------------------------------------------------------------
+# static rules: one positive + one negative fixture per rule
+# --------------------------------------------------------------------------
+
+
+class TestRuleFixtures:
+    def test_jl020_unlocked_shared_write(self):
+        pos = """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = "idle"
+
+                def locked_set(self, m):
+                    with self._lock:
+                        self.mode = m
+
+                def racy_set(self, m):
+                    self.mode = m
+        """
+        assert "JL020" in rules_of(pos)
+        # every write under the lock: clean
+        neg = """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = "idle"
+
+                def locked_set(self, m):
+                    with self._lock:
+                        self.mode = m
+        """
+        assert "JL020" not in rules_of(neg)
+        # an attr the class NEVER locks carries no contract (config
+        # fields, single-thread state): not tracked, not flagged
+        neg2 = """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = "idle"
+
+                def set_mode(self, m):
+                    self.mode = m
+        """
+        assert "JL020" not in rules_of(neg2)
+
+    def test_jl020_scopes_to_lock_owning_classes(self):
+        # no lock in the class -> callers own the locking; out of reach
+        neg = """
+            class Plain:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+        """
+        assert not {"JL020", "JL021"} & rules_of(neg)
+
+    def test_jl021_unlocked_rmw(self):
+        pos = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def undercount(self):
+                    self.n += 1
+        """
+        assert "JL021" in rules_of(pos)
+        # deque/dict mutation shapes count as RMW too
+        pos2 = """
+            import threading
+
+            class Window:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.samples = []
+                    self.by_key = {}
+
+                def locked_note(self, x):
+                    with self._lock:
+                        self.samples.append(x)
+                        self.by_key[x] = x
+
+                def racy_note(self, x):
+                    self.samples.append(x)
+                    self.by_key[x] = x
+        """
+        assert "JL021" in rules_of(pos2)
+        neg = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+        """
+        assert "JL021" not in rules_of(neg)
+
+    def test_jl021_resolves_the_stats_alias_idiom(self):
+        """`st = self.stats; st.n += 1` is the same shared state as
+        `self.stats.n += 1` — the exact spelling of the scheduler's
+        dispatcher-side counter bumps."""
+        pos = """
+            import threading
+
+            class Sched:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = make_stats()
+
+                def admitted(self):
+                    with self._lock:
+                        self.stats.submitted += 1
+
+                def dispatched(self):
+                    st = self.stats
+                    st.completed += 1
+        """
+        assert "JL021" in rules_of(pos)
+
+    def test_jl02x_lock_held_helper_fixpoint(self):
+        """A helper whose EVERY intra-class call site holds the lock is
+        lock-held (the _sweep/_note_affinity idiom) — its mutations are
+        sanctioned AND establish the tracking contract."""
+        neg = """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.expired = 0
+
+                def _sweep(self):
+                    self.expired += 1
+
+                def get(self):
+                    with self._lock:
+                        self._sweep()
+
+                def put(self):
+                    with self._lock:
+                        self._sweep()
+        """
+        assert "JL021" not in rules_of(neg)
+        # ...but a helper ALSO called unlocked is not exempt
+        pos = """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.expired = 0
+
+                def _sweep(self):
+                    self.expired += 1
+
+                def get(self):
+                    with self._lock:
+                        self._sweep()
+
+                def racy(self):
+                    self._sweep()
+        """
+        assert "JL021" in rules_of(pos)
+
+    def test_jl022_manual_acquire(self):
+        pos = """
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    self._lock.acquire()
+                    work()
+                    self._lock.release()
+        """
+        assert "JL022" in rules_of(pos)
+        # the sanctioned manual form: release in a finally
+        neg = """
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def guarded(self):
+                    if not self._lock.acquire(blocking=False):
+                        return False
+                    try:
+                        work()
+                    finally:
+                        self._lock.release()
+                    return True
+        """
+        assert "JL022" not in rules_of(neg)
+
+    def test_jl023_blocking_under_lock(self):
+        pos = """
+            import threading
+            import time
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        """
+        assert "JL023" in rules_of(pos)
+        # subprocess wait under the lock (the supervisor-respawn bug)
+        pos2 = """
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.procs = {}
+
+                def respawn(self, rid):
+                    with self._lock:
+                        self.procs[rid].wait(timeout=60.0)
+        """
+        assert "JL023" in rules_of(pos2)
+        neg = """
+            import threading
+            import time
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def good(self):
+                    with self._lock:
+                        snapshot = make()
+                    time.sleep(1.0)
+        """
+        assert "JL023" not in rules_of(neg)
+
+    def test_jl023_cv_wait_is_exempt(self):
+        """Condition.wait RELEASES the held lock while waiting — the
+        one sanctioned blocking wait under a lock (the scheduler's
+        dispatch loop)."""
+        neg = """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self.pending = 0
+
+                def loop(self):
+                    with self._cv:
+                        while self.pending == 0:
+                            self._cv.wait(timeout=0.05)
+        """
+        assert "JL023" not in rules_of(neg)
+
+    def test_jl023_str_join_not_confused_with_thread_join(self):
+        neg = """
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.names = []
+
+                def render(self):
+                    with self._lock:
+                        return ", ".join(self.names)
+        """
+        assert "JL023" not in rules_of(neg)
+        pos = """
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=run)
+
+                def stop(self):
+                    with self._lock:
+                        self._thread.join(timeout=5)
+        """
+        assert "JL023" in rules_of(pos)
+
+    def test_jl024_nested_order(self):
+        # declared registry order (chunk rank < stats rank): clean —
+        # and proves lock-attr discovery resolves OrderedLock names
+        neg = """
+            import threading
+
+            from dexiraft_tpu.analysis.locks import OrderedLock
+
+            class V:
+                def __init__(self):
+                    self._lock = OrderedLock("serve.video.chunk")
+                    self._stats_lock = OrderedLock("serve.video.stats")
+
+                def run(self):
+                    with self._lock:
+                        with self._stats_lock:
+                            pass
+        """
+        assert "JL024" not in rules_of(neg)
+        # inverted nesting of declared locks
+        pos = """
+            import threading
+
+            from dexiraft_tpu.analysis.locks import OrderedLock
+
+            class V:
+                def __init__(self):
+                    self._lock = OrderedLock("serve.video.chunk")
+                    self._stats_lock = OrderedLock("serve.video.stats")
+
+                def run(self):
+                    with self._stats_lock:
+                        with self._lock:
+                            pass
+        """
+        assert "JL024" in rules_of(pos)
+        # anonymous locks may not nest at all
+        pos2 = """
+            import threading
+
+            class V:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def run(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """
+        assert "JL024" in rules_of(pos2)
+        # a name missing from the central registry
+        pos3 = """
+            from dexiraft_tpu.analysis.locks import OrderedLock
+
+            class V:
+                def __init__(self):
+                    self._a = OrderedLock("serve.video.chunk")
+                    self._b = OrderedLock("not.in.registry")
+
+                def run(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """
+        assert "JL024" in rules_of(pos3)
+
+    def test_jl024_condition_wrapped_lock_discovered(self):
+        """Condition(OrderedLock(...)) carries the inner lock's name —
+        the scheduler-cv spelling."""
+        neg = """
+            import threading
+
+            from dexiraft_tpu.analysis.locks import OrderedLock
+
+            class S:
+                def __init__(self):
+                    self._cv = threading.Condition(
+                        OrderedLock("serve.scheduler.cv", reentrant=True))
+                    self._stats_lock = OrderedLock("serve.video.stats")
+
+                def run(self):
+                    with self._cv:
+                        with self._stats_lock:
+                            pass
+        """
+        assert "JL024" not in rules_of(neg)
+
+    def test_inline_suppression(self):
+        src = """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def undercount(self):
+                    self.n += 1  # jaxlint: disable=JL021
+        """
+        assert "JL021" not in rules_of(src)
+
+
+# --------------------------------------------------------------------------
+# the gate trips on every injected-footgun fixture (one invocation)
+# --------------------------------------------------------------------------
+
+
+_FOOTGUNS = {
+    "JL020": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.mode = 0
+
+            def a(self):
+                with self._lock:
+                    self.mode = 1
+
+            def b(self):
+                self.mode = 2
+    """,
+    "JL021": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def a(self):
+                with self._lock:
+                    self.n += 1
+
+            def b(self):
+                self.n += 1
+    """,
+    "JL022": """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._lock.acquire()
+                self._lock.release()
+    """,
+    "JL023": """
+        import threading
+        import time
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """,
+    "JL024": """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def bad(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """,
+}
+
+
+def test_gate_trips_on_each_rule_fixture(tmp_path):
+    """Acceptance pin: lint_gate exits nonzero on every JL02x footgun
+    (all five fixtures in ONE gate run to stay inside the test budget),
+    and --json reports the same verdict machine-readably."""
+    rels = []
+    for rule, src in _FOOTGUNS.items():
+        p = tmp_path / f"fixture_{rule.lower()}.py"
+        p.write_text(textwrap.dedent(src))
+        rels.append(osp.relpath(str(p), REPO))
+    r = subprocess.run([sys.executable, GATE, "--json", *rels], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    import json
+
+    blob = json.loads(r.stdout)
+    assert blob["ok"] is False
+    fired = {f["rule"] for f in blob["findings"]}
+    assert set(_FOOTGUNS) <= fired, (set(_FOOTGUNS) - fired, blob)
+    for rule in _FOOTGUNS:
+        assert blob["per_rule"][rule]["findings"] >= 1
+
+
+# --------------------------------------------------------------------------
+# static mirror == runtime registry
+# --------------------------------------------------------------------------
+
+
+def test_lock_order_mirror_matches_runtime():
+    """threadlint must stay package-import-free, so it mirrors the
+    runtime's LOCK_ORDER — this pin is what lets the mirror exist
+    (the shardlint LAYOUT_AXES idiom)."""
+    assert tuple(threadlint.LOCK_ORDER) == tuple(locks.LOCK_ORDER)
+
+
+# --------------------------------------------------------------------------
+# OrderedLock runtime semantics (private registries, fake clocks)
+# --------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestOrderedLockRuntime:
+    def test_rank_order_respected_is_clean(self):
+        reg = LockRegistry(order=("t.a", "t.b"), strict=True)
+        a = OrderedLock("t.a", registry=reg)
+        b = OrderedLock("t.b", registry=reg)
+        with a:
+            with b:
+                pass
+        rec = reg.stats_record()
+        assert rec["order_violations"] == 0 and rec["cycles"] == 0
+
+    def test_rank_inversion_raises_under_strict(self):
+        reg = LockRegistry(order=("t.a", "t.b"), strict=True)
+        a = OrderedLock("t.a", registry=reg)
+        b = OrderedLock("t.b", registry=reg)
+        with b:
+            with pytest.raises(LockOrderViolation, match="rank"):
+                a.acquire()
+        assert reg.stats_record()["order_violations"] == 1
+
+    def test_seeded_abba_cycle_caught_at_second_acquisition(self):
+        """The acceptance pin: thread 1 HOLDS A; this thread holds B
+        and tries A. OrderedLock raises at that second acquisition —
+        before blocking — so the detection is immediate, not a
+        timeout on an actually-deadlocked pair."""
+        reg = LockRegistry(order=("t.a", "t.b"), strict=True)
+        a = OrderedLock("t.a", registry=reg)
+        b = OrderedLock("t.b", registry=reg)
+        holding = threading.Event()
+        release = threading.Event()
+
+        def hold_a():
+            with a:
+                holding.set()
+                release.wait(10)
+
+        t = threading.Thread(target=hold_a, daemon=True)
+        t.start()
+        assert holding.wait(10)
+        t0 = time.monotonic()
+        try:
+            with b:
+                with pytest.raises(LockOrderViolation):
+                    a.acquire()   # A is HELD by t: blocking would deadlock
+        finally:
+            release.set()
+            t.join(10)
+        # caught by the order check, not by waiting out the holder
+        assert time.monotonic() - t0 < 2.0
+        assert not t.is_alive()
+
+    def test_unranked_cycle_detected_from_acquisition_graph(self):
+        """Locks outside LOCK_ORDER have no ranks — the graph still
+        catches an ABBA pair: A->B taught by one path, B->A closes the
+        cycle."""
+        reg = LockRegistry(order=(), strict=True)
+        a = OrderedLock("t.alpha", registry=reg)
+        b = OrderedLock("t.beta", registry=reg)
+        with a:
+            with b:
+                pass          # records edge alpha -> beta
+        with b:
+            with pytest.raises(LockOrderViolation, match="cycle"):
+                a.acquire()   # beta -> alpha would close the loop
+        assert reg.stats_record()["cycles"] == 1
+
+    def test_non_strict_counts_and_proceeds(self, capsys):
+        reg = LockRegistry(order=("t.a", "t.b"), strict=False)
+        a = OrderedLock("t.a", registry=reg)
+        b = OrderedLock("t.b", registry=reg)
+        with b:
+            with a:               # inversion: warned, not raised
+                pass
+        with b:
+            with a:               # same edge: warn-once stays quiet
+                pass
+        rec = reg.stats_record()
+        assert rec["order_violations"] == 2   # every occurrence counted
+        assert rec["violations"]              # ...and retained for /stats
+        err = capsys.readouterr().err
+        assert err.count("rank-inversion") == 1   # printed once
+
+    def test_reentrant_reacquire_is_not_a_violation(self):
+        reg = LockRegistry(order=("t.r",), strict=True)
+        r = OrderedLock("t.r", reentrant=True, registry=reg)
+        with r:
+            with r:
+                pass
+        rec = reg.stats_record()
+        assert rec["order_violations"] == 0 and rec["cycles"] == 0
+        # one SPAN, not two: the inner re-acquire is depth bookkeeping
+        assert rec["by_lock"]["t.r"]["acquisitions"] == 1
+
+    def test_nonreentrant_self_reacquire_raises_always(self):
+        reg = LockRegistry(order=(), strict=False)   # even non-strict
+        lk = OrderedLock("t.sd", registry=reg)
+        lk.acquire()
+        try:
+            with pytest.raises(LockOrderViolation, match="self-deadlock"):
+                lk.acquire()
+            # a non-blocking probe by the OWNING thread answers False
+            # (threading.Condition's default _is_owned protocol)
+            assert lk.acquire(blocking=False) is False
+        finally:
+            lk.release()
+
+    def test_held_too_long_and_max_held_on_fake_clock(self):
+        clock = FakeClock()
+        reg = LockRegistry(order=("t.h",), strict=True,
+                           held_warn_ms=10.0, clock=clock)
+        h = OrderedLock("t.h", registry=reg)
+        with h:
+            clock.t += 0.5          # 500 ms held
+        with h:
+            clock.t += 0.002        # 2 ms: under the threshold
+        rec = reg.stats_record()["by_lock"]["t.h"]
+        assert rec["max_held_ms"] == 500.0
+        assert rec["held_too_long"] == 1
+        assert rec["acquisitions"] == 2
+
+    def test_contended_acquisition_counted(self):
+        reg = LockRegistry(order=("t.c",), strict=True)
+        c = OrderedLock("t.c", registry=reg)
+        held = threading.Event()
+
+        def holder():
+            with c:
+                held.set()
+                time.sleep(0.05)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert held.wait(10)
+        with c:                     # blocks ~50 ms behind the holder
+            pass
+        t.join(10)
+        assert c.contended == 1
+
+    def test_same_name_instance_nesting_flagged(self):
+        """Two instances sharing one registry name cannot be ranked by
+        the name order — nesting them is an undetectable-ABBA hazard
+        and is flagged AT the nesting (no silent blind spot for e.g.
+        two same-class stores)."""
+        reg = LockRegistry(order=("t.twin",), strict=True)
+        a = OrderedLock("t.twin", registry=reg)
+        b = OrderedLock("t.twin", registry=reg)
+        with a:
+            with pytest.raises(LockOrderViolation, match="same-name"):
+                b.acquire()
+        assert reg.stats_record()["order_violations"] == 1
+
+    def test_reentrant_locked_reports_owner_held(self):
+        reg = LockRegistry(order=("t.rl",), strict=True)
+        r = OrderedLock("t.rl", reentrant=True, registry=reg)
+        assert r.locked() is False
+        with r:
+            # a bare RLock probe would succeed reentrantly and claim
+            # "unlocked" to the very thread holding it
+            assert r.locked() is True
+        assert r.locked() is False
+
+    def test_release_by_non_owner_raises(self):
+        """Cross-thread release would strand the acquirer's held-stack
+        entry (phantom nesting -> false violations forever) — the
+        misuse raises instead of corrupting the bookkeeping."""
+        reg = LockRegistry(order=(), strict=False)
+        lk = OrderedLock("t.handoff", registry=reg)
+        lk.acquire()
+        errs: list = []
+
+        def other():
+            try:
+                lk.release()
+            except RuntimeError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=other, daemon=True)
+        t.start()
+        t.join(10)
+        assert errs and "does not hold it" in str(errs[0])
+        lk.release()           # the owner's release still works
+        with lk:
+            pass               # and the lock stays usable
+
+    def test_condition_over_ordered_lock(self):
+        """The scheduler-cv integration: wait releases the lock (and
+        the held-stack entry with it), notify hands it back."""
+        reg = LockRegistry(order=("t.cv",), strict=True)
+        cv = threading.Condition(
+            OrderedLock("t.cv", reentrant=True, registry=reg))
+        done: list = []
+        woke = threading.Event()
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(timeout=1.0)
+                woke.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        with cv:
+            done.append(1)
+            cv.notify_all()
+        assert woke.wait(10)
+        t.join(10)
+        assert not t.is_alive()
+        assert reg.stats_record()["order_violations"] == 0
+
+
+# --------------------------------------------------------------------------
+# the locks stats block: schema pin (what /stats and chaos_smoke consume)
+# --------------------------------------------------------------------------
+
+
+def test_locks_stats_block_schema_pin():
+    reg = LockRegistry(order=("t.pin",), strict=False)
+    lk = OrderedLock("t.pin", registry=reg)
+    with lk:
+        pass
+    rec = reg.stats_record()
+    assert set(rec) == {"strict", "order_violations", "cycles",
+                        "held_too_long", "violations", "by_lock"}
+    assert set(rec["by_lock"]["t.pin"]) == {
+        "acquisitions", "contended", "max_held_ms", "held_too_long"}
+    # the module-level block (what FlowService/Router /stats embed)
+    glob = locks.stats_record()
+    assert set(glob) == set(rec)
+
+
+def test_global_registry_is_clean_and_strict_under_tests():
+    """The suite-wide canary: conftest arms strict mode, and no tier-1
+    test may leave a violation behind (a seeded-violation test that
+    touched the GLOBAL registry would trip this)."""
+    rec = locks.stats_record()
+    assert rec["strict"] is True
+    assert rec["order_violations"] == 0
+    assert rec["cycles"] == 0
