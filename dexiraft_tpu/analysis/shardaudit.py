@@ -18,17 +18,20 @@ resolved sharding off the compiled executables, and
     fully replicated and is not pinned as replicated-by-design in
     ``parallel.layout.REPLICATED_OK``.
 
-Two goldens since the fsdp axis went live: ``layout_golden.json`` pins
-the data x seq (and serve) legs exactly as before, and
-``layout_golden_fsdp.json`` pins the train step on the virtual
-{data x fsdp x seq} mesh — params/opt_state resolved to their per-leaf
-fsdp storage shardings, divisibility-fallback leaves replicated, and
-the over-threshold replicated canary armed on them with no
-REPLICATED_OK exemption.
+Three goldens: ``layout_golden.json`` pins the data x seq (and serve)
+legs exactly as before; ``layout_golden_fsdp.json`` pins the FENCE
+train step on the virtual {data x fsdp x seq} mesh — params/opt_state
+resolved to their per-leaf fsdp storage shardings, divisibility-
+fallback leaves replicated, and the over-threshold replicated canary
+armed on them with no REPLICATED_OK exemption; and
+``layout_golden_halo.json`` pins the HALO compute-sharded train step
+(compute_sharding="halo") on the same mesh — identical state storage
+groups, batch leaves P('data', 'seq') as shard_map slab inputs, and
+the declared halo_activations canary armed at the production geometry.
 
 Run it via ``scripts/shard_audit.py`` (which forces the host platform
 before jax initializes); the tier-1 verify command runs it right after
-``lint_gate.py`` and audits BOTH goldens by default. Regeneration
+``lint_gate.py`` and audits ALL goldens by default. Regeneration
 workflow: docs/static_analysis.md.
 
 Granularity note: shardings are reported per GROUP (a state field, a
@@ -55,6 +58,14 @@ GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: data x seq golden's semantics stay untouched.
 FSDP_GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "layout_golden_fsdp.json")
+#: The halo compute-sharding leg's golden: the train step compiled with
+#: compute_sharding="halo" on the same {data x fsdp x seq} mesh. Its
+#: semantics differ from the fsdp leg's in exactly the ways the mode
+#: promises — batch leaves resolve P('data', 'seq') INTO a shard_map
+#: (explicit slabs, not GSPMD annotations), the state keeps its fsdp
+#: storage layout with NO gather fence inside, and metrics replicate.
+HALO_GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "layout_golden_halo.json")
 
 #: Audit geometry: small model + tiny frames keep the three compiles
 #: ~a minute on CPU; the SPECS resolved are geometry-independent.
@@ -185,6 +196,35 @@ def audit_train_fsdp(mesh=None) -> Dict[str, Any]:
         mesh = make_mesh_fsdp(FSDP_MESH["data"], FSDP_MESH["fsdp"],
                               FSDP_MESH["seq"])
     return audit_train(mesh)
+
+
+def audit_train_halo(mesh=None) -> Dict[str, Any]:
+    """The halo compute-sharding leg: the train step built with
+    compute_sharding="halo" on the {data x fsdp x seq} mesh
+    (train/step._make_halo_train_step -> parallel/halo). The golden
+    pins the mode's whole contract at the jit boundary: state in/out in
+    fsdp STORAGE layout (identical groups to the fsdp leg — the two
+    modes interchange on the same stored state), batch leaves
+    P('data', 'seq') as shard_map slab inputs, loss/metrics replicated.
+    The audit geometry satisfies the halo divisibility rules by
+    construction (48 rows / (8*2) = 3 feature rows per seq device)."""
+    from dexiraft_tpu.config import TrainConfig, raft_v1
+    from dexiraft_tpu.parallel.layout import make_mesh_fsdp
+    from dexiraft_tpu.train.step import make_train_step
+
+    if mesh is None:
+        mesh = make_mesh_fsdp(FSDP_MESH["data"], FSDP_MESH["fsdp"],
+                              FSDP_MESH["seq"])
+    h, w = AUDIT_IMAGE
+    cfg = raft_v1(small=True)
+    tc = TrainConfig(name="shardaudit", stage="chairs", num_steps=10,
+                     batch_size=AUDIT_BATCH, image_size=(h, w),
+                     iters=AUDIT_ITERS)
+    step = make_train_step(cfg, tc, mesh=mesh, compute_sharding="halo")
+    state = _audit_state(cfg, tc)
+    sections = _compiled_sections(step, (state, _batch_avals(AUDIT_BATCH,
+                                                             h, w)))
+    return {"mesh": _mesh_dict(mesh), **sections}
 
 
 def _audit_eval_step(mesh) -> Dict[str, Any]:
@@ -319,7 +359,7 @@ def audit_serve_refine(mesh=None) -> Dict[str, Any]:
 
 
 def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB,
-                    mesh=None) -> Dict[str, Any]:
+                    mesh=None, halo: bool = False) -> Dict[str, Any]:
     """Resolve the layout's declared array groups at the PRODUCTION
     reference geometry: per-group canonical spec, total bytes, bytes
     per device, and the replicated-over-threshold flag. This is where
@@ -363,6 +403,17 @@ def declared_groups(threshold_mb: float = DEFAULT_THRESHOLD_MB,
         ("params", LAYOUT.params(mesh), 5_300_000 * 4),
         ("opt_state", LAYOUT.opt_state(mesh), 2 * 5_300_000 * 4),
     ]
+    if halo:
+        # halo-mode ACTIVATIONS canary (the halo leg only): the sharded
+        # forward's persistent feature-map working set — fmap1 + fmap2 +
+        # context, (B, H/8, W/8, 256) fp32 each at the reference
+        # geometry (~165 MB full-batch at 440x1024). Declared with the
+        # shard_map slab spec P('data', 'seq'); if a layout change ever
+        # resolves it fully replicated it trips the 64 MB wire with no
+        # REPLICATED_OK exemption — replicated activations at pod batch
+        # sizes are exactly the regression halo mode exists to prevent
+        entries.append(("halo_activations",
+                        LAYOUT.batch_spatial_compute(), 3 * fmap_bytes))
     mesh_shape = dict(mesh.shape)
     out = {}
     for name, spec, total in entries:
@@ -396,6 +447,8 @@ STEP_AUDITS = {"train": audit_train, "eval": audit_eval,
                "serve_refine": audit_serve_refine}
 #: Steps audited against the SEPARATE fsdp golden (FSDP_GOLDEN_PATH).
 FSDP_STEP_AUDITS = {"train_fsdp": audit_train_fsdp}
+#: Steps audited against the halo golden (HALO_GOLDEN_PATH).
+HALO_STEP_AUDITS = {"train_halo": audit_train_halo}
 
 
 def _report_header() -> Dict[str, Any]:
@@ -441,6 +494,29 @@ def run_audit_fsdp(steps: Sequence[str] = ("train_fsdp",),
     }
     for name in steps:
         report["steps"][name] = FSDP_STEP_AUDITS[name]()
+    return report
+
+
+def run_audit_halo(steps: Sequence[str] = ("train_halo",),
+                   threshold_mb: float = DEFAULT_THRESHOLD_MB
+                   ) -> Dict[str, Any]:
+    """The halo report, diffed against HALO_GOLDEN_PATH: the
+    compute_sharding="halo" train step on the {data x fsdp x seq} mesh
+    plus the declared groups re-resolved there WITH the
+    halo_activations canary (declared_groups(halo=True)) — the sharded
+    forward's feature-map set declared P('data', 'seq') and the 64 MB
+    replicated tripwire armed on it."""
+    from dexiraft_tpu.parallel.layout import make_mesh_fsdp
+
+    mesh = make_mesh_fsdp(FSDP_MESH["data"], FSDP_MESH["fsdp"],
+                          FSDP_MESH["seq"])
+    report: Dict[str, Any] = {
+        **_report_header(),
+        "steps": {},
+        "declared": declared_groups(threshold_mb, mesh=mesh, halo=True),
+    }
+    for name in steps:
+        report["steps"][name] = HALO_STEP_AUDITS[name]()
     return report
 
 

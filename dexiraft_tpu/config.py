@@ -109,6 +109,15 @@ class RAFTConfig:
     # activations of the scanned step are recomputed instead of stored,
     # trading FLOPs for HBM (jax.checkpoint over the scan body)
     remat: bool = False
+    # what the per-iteration checkpoint SAVES when remat=True:
+    #   "full"          — save nothing, recompute everything (the
+    #                     historical behavior; max HBM savings)
+    #   "dots_saveable" — save matmul/conv outputs, recompute the cheap
+    #                     elementwise chains (jax.checkpoint_policies.
+    #                     dots_saveable): most of the memory win at a
+    #                     fraction of the recompute FLOPs — the middle
+    #                     point the train_bench HBM columns quantify
+    remat_policy: str = "full"
     # rematerialize ONLY the correlation lookup: drops the per-iteration
     # one-hot hat matrices — the dominant training-memory term (measured
     # 5x1.57 GB with up to 15x lane padding at batch 6, 368x496; see
@@ -149,6 +158,10 @@ class RAFTConfig:
                 "blocked HBM-streaming kernel — the production default) "
                 "or 'pallas' (the per-pixel VMEM formulation); the "
                 "allpairs volume cannot be tiled per pixel block")
+        if self.remat_policy not in ("full", "dots_saveable"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; expected "
+                "'full' or 'dots_saveable'")
 
     @property
     def radius(self) -> int:
@@ -251,6 +264,15 @@ class TrainConfig:
     # (image1, image2) and on the edge-image pair, and sum the per-iter
     # flow predictions before the sequence loss; requires edge-pair data
     edge_sum_fusion: bool = False
+    # rematerialization policy axis for the TRAIN step (the bench's
+    # --remat knob): "none" stores every refinement iteration's
+    # activations; "per_iter" checkpoints each scanned iteration and
+    # recomputes everything in the backward (cfg.remat with
+    # remat_policy="full"); "dots_saveable" checkpoints each iteration
+    # but SAVES matmul/conv outputs (jax.checkpoint_policies
+    # .dots_saveable) — most of per_iter's HBM win at a fraction of its
+    # recompute FLOPs. Numerically identical on all three settings
+    remat: str = "none"
     freeze_bn: bool = False  # true for all post-chairs stages (train.py:149-150)
     val_freq: int = 5000
     sum_freq: int = 100

@@ -9,6 +9,12 @@ shape buckets (dexiraft_tpu.serve): instead of the next stride multiple,
 pad out to an arbitrary (stride-aligned, >= input) bucket shape with the
 same replicate-edge placement rules, and unpad per item on the way out.
 target=None is bit-for-bit the reference behavior.
+
+`seq=` aligns HEIGHT for halo compute sharding (parallel/halo.py):
+each of the mesh's n_seq devices owns a contiguous block of feature
+rows, so the padded height must divide by stride*seq — the effective
+height alignment becomes stride*seq while width keeps plain stride.
+seq=1 (default) is the unchanged single-slab contract.
 """
 
 from __future__ import annotations
@@ -20,10 +26,15 @@ import numpy as np
 
 class InputPadder:
     def __init__(self, shape: Sequence[int], mode: str = "sintel", stride: int = 8,
-                 target: Optional[Tuple[int, int]] = None):
+                 target: Optional[Tuple[int, int]] = None, seq: int = 1):
         self.ht, self.wd = int(shape[-3]), int(shape[-2])  # NHWC
+        if seq < 1:
+            raise ValueError(f"seq must be >= 1, got {seq}")
+        h_align = stride * seq  # rows split into seq slabs of whole
+        # stride-blocks each; width never shards, so it keeps stride
         if target is None:
-            pad_ht = (((self.ht // stride) + 1) * stride - self.ht) % stride
+            pad_ht = (((self.ht // h_align) + 1) * h_align - self.ht) \
+                % h_align
             pad_wd = (((self.wd // stride) + 1) * stride - self.wd) % stride
         else:
             tht, twd = int(target[0]), int(target[1])
@@ -34,6 +45,12 @@ class InputPadder:
             if tht % stride or twd % stride:
                 raise ValueError(
                     f"pad target {tht}x{twd} not stride-{stride} aligned")
+            if tht % h_align:
+                raise ValueError(
+                    f"pad target height {tht} not divisible by "
+                    f"stride*seq = {stride}*{seq} = {h_align} — pick a "
+                    f"bucket height that splits into {seq} whole-stride "
+                    "row slabs")
             pad_ht, pad_wd = tht - self.ht, twd - self.wd
         if mode == "sintel":
             # [left, right, top, bottom]

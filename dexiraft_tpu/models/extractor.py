@@ -138,13 +138,52 @@ class Encoder(nn.Module):
         return x
 
 
+#: Stage (planes, stride) schedules — shared by the encoder factories
+#: below and the declarative conv chains so the two cannot drift.
+BASIC_STAGES: Tuple[Tuple[int, int], ...] = ((64, 1), (96, 2), (128, 2))
+SMALL_STAGES: Tuple[Tuple[int, int], ...] = ((32, 1), (64, 2), (96, 2))
+
+
 def BasicEncoder(output_dim=128, norm_fn="batch", dropout=0.0, dtype=jnp.float32, name=None):
     """Residual encoder (64, 96/2, 128/2). Reference: core/extractor.py:118-192."""
     return Encoder(output_dim, norm_fn, dropout, dtype, block="residual",
-                   stem_width=64, stages=((64, 1), (96, 2), (128, 2)), name=name)
+                   stem_width=64, stages=BASIC_STAGES, name=name)
 
 
 def SmallEncoder(output_dim=128, norm_fn="batch", dropout=0.0, dtype=jnp.float32, name=None):
     """Bottleneck encoder (32, 64/2, 96/2). Reference: core/extractor.py:195-267."""
     return Encoder(output_dim, norm_fn, dropout, dtype, block="bottleneck",
-                   stem_width=32, stages=((32, 1), (64, 2), (96, 2)), name=name)
+                   stem_width=32, stages=SMALL_STAGES, name=name)
+
+
+# --------------------------------------------------------------------------
+# Declarative H-axis conv chains — the halo machinery's source of truth
+# --------------------------------------------------------------------------
+
+#: One chain entry per conv, (kernel, stride, padding) along the H axis,
+#: in forward order. parallel/halo.py composes these into each module's
+#: receptive-field halo width (``halo_rows``), so they are pinned NEXT
+#: to the convs they describe — a kernel-size change here is a one-line
+#: diff away from the exchange width that must follow it, instead of
+#: folklore in a distant table.
+
+
+def block_conv_chain(block: str, stride: int) -> Tuple[Tuple[int, int, int], ...]:
+    """Deepest sequential H-axis conv path of one block. The 1x1 skip
+    conv is a parallel path with zero halo and is omitted — the halo a
+    block needs is governed by its longest path."""
+    if block == "residual":
+        return ((3, stride, 1), (3, 1, 1))
+    return ((1, 1, 0), (3, stride, 1), (1, 1, 0))
+
+
+def encoder_conv_chain(block: str = "residual") -> Tuple[Tuple[int, int, int], ...]:
+    """The full sequential H-axis conv chain of one Encoder forward:
+    7x7/2 stem -> two blocks per stage -> 1x1 projection."""
+    stages = BASIC_STAGES if block == "residual" else SMALL_STAGES
+    chain = [(7, 2, 3)]
+    for _, stride in stages:
+        chain += list(block_conv_chain(block, stride))
+        chain += list(block_conv_chain(block, 1))
+    chain.append((1, 1, 0))
+    return tuple(chain)

@@ -434,8 +434,14 @@ class RAFT(nn.Module):
         step_cls = RAFTStep
         if cfg.remat:
             # recompute each iteration's activations in backward instead
-            # of storing iters x (GRU state + corr features) in HBM
-            step_cls = nn.remat(RAFTStep, prevent_cse=False)
+            # of storing iters x (GRU state + corr features) in HBM;
+            # remat_policy="dots_saveable" keeps matmul/conv outputs
+            # saved (cheap elementwise chains recompute) — the
+            # intermediate point on the HBM/FLOPs axis (config.py)
+            kw = {}
+            if cfg.remat_policy == "dots_saveable":
+                kw["policy"] = jax.checkpoint_policies.dots_saveable
+            step_cls = nn.remat(RAFTStep, prevent_cse=False, **kw)
         scan = nn.scan(
             step_cls,
             variable_broadcast="params",

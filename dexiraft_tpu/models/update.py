@@ -242,6 +242,25 @@ class BasicUpdateBlock(nn.Module):
         return net, mask, delta_flow
 
 
+# --------------------------------------------------------------------------
+# Declarative H-axis conv chains — the halo machinery's source of truth
+# --------------------------------------------------------------------------
+
+#: (kernel, stride, padding) along the H axis, deepest sequential path,
+#: forward order — parallel/halo.py composes these into per-module
+#: receptive-field halo widths (see models/extractor.py for the
+#: convention). Parallel branches take the longest path: both motion
+#: encoders are bounded by flow(7x7) -> 3x3 -> concat-conv(3x3); the
+#: GRUs by the r -> q dependency (z is parallel to r), which for the
+#: separable GRU only counts the VERTICAL (5x1) pass — the (1x5)
+#: horizontal pass has H-kernel 1.
+MOTION_ENCODER_CHAIN = ((7, 1, 3), (3, 1, 1), (3, 1, 1))
+CONV_GRU_CHAIN = ((3, 1, 1), (3, 1, 1))
+SEP_CONV_GRU_CHAIN = ((5, 1, 2), (5, 1, 2))
+FLOW_HEAD_CHAIN = ((3, 1, 1), (3, 1, 1))
+MASK_HEAD_CHAIN = ((3, 1, 1), (1, 1, 0))
+
+
 class RefineFlow(nn.Module):
     """1x1-conv fusion of (flow_up, eflow_up) -> refined 2-channel flow.
 

@@ -33,15 +33,20 @@ Axes (``SpecLayout``):
           norm params, scalars) and leaves with no dividing dim stay
           replicated, and call sites never decide.
 
-fsdp is a STORAGE axis, not a compute axis: the train step gathers the
-state to replicated at entry and re-shards at exit (train/step.py's
+fsdp is a STORAGE axis by default: the fence-mode train step gathers
+the state to replicated at entry and re-shards at exit (train/step.py's
 fence pattern — see docs/perf.md "Sharded state (fsdp)" for why the
-partitioner must never see fsdp-sharded tensors inside the model:
+GSPMD partitioner must never see fsdp-sharded tensors inside the model:
 feature-dim-partitioned convolutions miscompile under this backend's
-GSPMD, pinned by tests/test_zzzfsdp.py). The persistent HBM win —
-params + Adam moments at ~1/N per device between steps, and per-shard
-checkpoint I/O — is exactly what the ``state_bytes_per_device`` bench
-metric records.
+GSPMD, pinned by tests/test_zzzfsdp.py). The halo compute-sharding mode
+(parallel/halo.py, ``make_train_step(compute_sharding="halo")``) keeps
+fsdp sharded DURING compute too — per-block all-gather inside a
+shard_map body, where GSPMD never sees the gathered tensors — and
+shards the spatial compute itself over 'seq' with explicit ppermute
+halo exchange (:meth:`SpecLayout.batch_spatial_compute`,
+:func:`seq_halo_perms`). The persistent HBM win — params + Adam
+moments at ~1/N per device between steps, and per-shard checkpoint I/O
+— is exactly what the ``state_bytes_per_device`` bench metric records.
 
 The compat surface ``parallel/mesh.py`` re-exports everything below, so
 existing imports keep working; new code should import from here.
@@ -147,6 +152,18 @@ class SpecLayout:
         exchange and the correlation volume by query rows."""
         return PartitionSpec(self.data_axis, self.seq_axis)
 
+    def batch_spatial_compute(self) -> PartitionSpec:
+        """shard_map in/out spec for HALO compute sharding
+        (parallel/halo.py): batch leaves enter the body as per-device
+        (B/data, H/seq, ...) slabs — batch over 'data', contiguous image
+        rows over 'seq'. Same axes as :meth:`batch_spatial`, but pinned
+        as its own canonical surface: batch_spatial is a GSPMD
+        annotation (the partitioner decides the collectives), while this
+        spec is a shard_map CONTRACT — the body sees local slabs and
+        does its own ppermute halo exchange (:func:`seq_halo_perms`), so
+        the audit tracks the two modes separately."""
+        return PartitionSpec(self.data_axis, self.seq_axis)
+
     def carry(self) -> PartitionSpec:
         """Flow/carry state (flow_init, flow_low — (B, H/8, W/8, 2)):
         batch-sharded like the frames it warm-starts."""
@@ -210,6 +227,10 @@ class SpecLayout:
     def fsdp_size(self, mesh: Mesh) -> int:
         """Number of ways params/opt_state shard on this mesh."""
         return dict(mesh.shape).get(self.fsdp_axis, 1)
+
+    def seq_size(self, mesh: Mesh) -> int:
+        """Number of ways image rows shard on this mesh's seq axis."""
+        return dict(mesh.shape).get(self.seq_axis, 1)
 
 
 #: The one layout instance application code threads around.
@@ -441,6 +462,32 @@ def state_sharding(mesh: Mesh, state: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
+def variables_sharding(mesh: Mesh, variables: Any) -> Any:
+    """Per-leaf NamedSharding tree for a flax variables dict
+    ({"params": ..., "batch_stats": ...}): leaves under "params"
+    resolve via LAYOUT.param_leaf_spec — the same storage layout the
+    train state pins — and every other collection replicates. The halo
+    eval step (train/step.py, ``compute_sharding="halo"``) pins its
+    variables argument with this tree, so eval consumes fsdp-STORED
+    params directly (the shard_map body gathers per block); on meshes
+    without an fsdp axis every leaf resolves replicated. ``variables``
+    may be abstract — only shapes are read."""
+    repl = replicated_sharding(mesh)
+    if not LAYOUT.has_fsdp(mesh):
+        return jax.tree.map(lambda _: repl, variables)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
+    shardings = []
+    for path, leaf in flat:
+        top = path[0]
+        key = getattr(top, "key", getattr(top, "name", None))
+        if key == "params":
+            shardings.append(
+                named(mesh, LAYOUT.param_leaf_spec(mesh, np.shape(leaf))))
+        else:
+            shardings.append(repl)
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
 def shard_state(state: Any, mesh: Mesh) -> Any:
     """Device-put a host/replicated TrainState into its storage layout
     (state_sharding). Multi-process safe: sharded leaves assemble via
@@ -536,6 +583,41 @@ def batch_putter(mesh: Optional[Mesh]):
     if LAYOUT.has_seq(mesh):
         return lambda batch: shard_batch_spatial(batch, mesh)
     return lambda batch: shard_batch(batch, mesh)
+
+
+# --------------------------------------------------------------------------
+# halo compute sharding — the seq-axis exchange topology and the
+# per-block gather schedule (parallel/halo.py consumes both; they live
+# HERE so every ppermute call site draws its permutation and axis name
+# from the layout, per JL011)
+# --------------------------------------------------------------------------
+
+
+def seq_halo_perms(n_seq: int) -> Tuple[list, list]:
+    """ppermute permutation pairs for NON-CIRCULAR neighbor halo
+    exchange over the seq axis: ``fwd`` sends each device's boundary
+    rows to its successor (filling the successor's TOP halo), ``bwd``
+    to its predecessor (BOTTOM halo).
+
+    Non-circular on purpose: ppermute zero-fills unaddressed outputs,
+    which is byte-identical to the unsharded program's symmetric zero
+    padding at the global image edges — device 0's top halo and device
+    n-1's bottom halo get exactly the zeros the global conv would pad,
+    so no edge-device special-casing exists anywhere downstream."""
+    fwd = [(i, i + 1) for i in range(n_seq - 1)]
+    bwd = [(i + 1, i) for i in range(n_seq - 1)]
+    return fwd, bwd
+
+
+def param_block_names(params: Any) -> Tuple[str, ...]:
+    """The per-block all-gather schedule for halo compute sharding: the
+    top-level module keys of the param tree (fnet / cnet /
+    ScanRAFTStep_0), in tree order. Each block's leaves are gathered
+    from their fsdp shards immediately before the block runs and
+    dropped after (gather→use→drop), so peak gathered-params HBM is one
+    block, not the tree. Pinned here so the step, the audit's declared
+    groups, and the docs table agree on the grouping."""
+    return tuple(params)
 
 
 def spec_str(spec: PartitionSpec) -> str:

@@ -11,11 +11,27 @@ replacement for DataParallel's NCCL gather, SURVEY.md §2.7).
 On an fsdp mesh (parallel/layout.make_train_mesh(..., fsdp=...)) the
 state is additionally STORED sharded: params and Adam moments live
 split over the 'fsdp' axis between steps (per-leaf layout in
-layout.state_sharding), the step gathers them to replicated at entry
-and re-shards at exit — the fence pattern documented in docs/perf.md
-"Sharded state (fsdp)". Compute inside the fences is byte-for-byte the
-replicated program; what changes is the persistent per-device HBM
-(state at ~1/fsdp) and the checkpoint path (per-shard orbax I/O).
+layout.state_sharding). How the COMPUTE relates to that storage is the
+``compute_sharding`` axis:
+
+  * "fence" (default) — the step gathers the state to replicated at
+    entry and re-shards at exit (the fence pattern, docs/perf.md
+    "Sharded state (fsdp)"). Compute inside the fences is byte-for-byte
+    the replicated program; what changes is the persistent per-device
+    HBM (state at ~1/fsdp) and the checkpoint path (per-shard orbax
+    I/O). Works for every variant/config.
+  * "halo" — the heavy spatial compute itself shards: a shard_map over
+    the mesh's (data, seq) axes gives each device a contiguous
+    image-row slab, convolutions exchange receptive-field boundary rows
+    with lax.ppermute (parallel/halo.py), and params stay fsdp-sharded
+    THROUGH compute — each block all-gathers its weights immediately
+    before running and drops them after (gather->use->drop inside
+    jax.checkpoint), so peak gathered-params HBM is one block. The
+    optimizer update runs OUTSIDE the shard_map on the sharded grads
+    (elementwise; GSPMD partitions it over fsdp for free), so no
+    fences exist anywhere in this mode. v1/fp32-only support matrix:
+    halo.check_halo_support refuses everything else with actionable
+    errors.
 
 BatchNorm note: under a sharded batch the normalizing statistics are
 GLOBAL across chips (XLA inserts the cross-chip mean) — i.e. sync-BN.
@@ -34,11 +50,13 @@ from jax.sharding import Mesh
 from dexiraft_tpu.config import RAFTConfig, TrainConfig
 from dexiraft_tpu.models.raft import RAFT
 from dexiraft_tpu.ops.losses import sequence_loss
+from dexiraft_tpu.parallel import halo
 from dexiraft_tpu.parallel.layout import (
     LAYOUT,
     batch_input_sharding,
     replicated_sharding,
     state_sharding,
+    variables_sharding,
 )
 from dexiraft_tpu.train.optimizer import training_schedule
 from dexiraft_tpu.train.state import TrainState, create_state, make_optimizer_from
@@ -85,13 +103,37 @@ def make_train_step(
     cfg: RAFTConfig,
     tc: TrainConfig,
     mesh: Optional[Mesh] = None,
+    compute_sharding: str = "fence",
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step. With a mesh, in/out shardings pin the
-    batch to the 'data' axis and everything else replicated."""
+    batch to the 'data' axis (rows additionally over 'seq' on 2-D
+    meshes) and everything else replicated/fsdp-stored.
+    ``compute_sharding`` picks how fsdp storage meets compute: "fence"
+    gathers at entry / re-shards at exit; "halo" shard_maps the spatial
+    compute with explicit halo exchange and keeps params sharded
+    throughout (module docstring has the full contrast)."""
     if tc.precision not in ("fp32", "bf16"):
         raise ValueError(f"precision must be fp32|bf16, got {tc.precision!r}")
     if tc.accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {tc.accum_steps}")
+    if compute_sharding not in ("fence", "halo"):
+        raise ValueError(f"compute_sharding must be fence|halo, "
+                         f"got {compute_sharding!r}")
+    if tc.remat not in ("none", "per_iter", "dots_saveable"):
+        raise ValueError(f"remat must be none|per_iter|dots_saveable, "
+                         f"got {tc.remat!r}")
+    if tc.remat != "none":
+        import dataclasses
+
+        # thread the TrainConfig remat axis into the model config: both
+        # checkpointing modes wrap the scanned iteration; the policy
+        # decides what the checkpoint saves (config.py remat_policy)
+        cfg = dataclasses.replace(
+            cfg, remat=True,
+            remat_policy=("dots_saveable" if tc.remat == "dots_saveable"
+                          else "full"))
+    if compute_sharding == "halo":
+        return _make_halo_train_step(cfg, tc, mesh)
     # bf16 training policy: force the MODEL's mixed-precision path —
     # module compute dtype becomes bf16, so flax casts each op's params
     # from the fp32 masters per use (autodiff transposes the casts and
@@ -287,10 +329,67 @@ def make_train_step(
     )
 
 
+def _make_halo_train_step(
+    cfg: RAFTConfig,
+    tc: TrainConfig,
+    mesh: Optional[Mesh],
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """The compute_sharding="halo" train step (make_train_step
+    dispatches here): the shard_map'd gradient fn from
+    parallel/halo.py plus the optimizer update OUTSIDE the shard_map.
+
+    Grads leave the shard_map already in the params' fsdp storage
+    layout, so the Adam update (elementwise per leaf; the global-norm
+    clip reduces over shards, which GSPMD handles) never materializes a
+    replicated param tree — persistent AND peak optimizer HBM stay at
+    ~1/fsdp. batch_stats pass through unchanged: halo trains with
+    instance norm / frozen BN only (check_halo_support), so there are
+    no running-stat updates to thread. The rng splits once per step to
+    keep the TrainState contract (fresh carry each step) even though
+    the halo forward draws no randomness (dropout/noise refused)."""
+    halo.check_halo_support(cfg, tc, mesh)
+    tx = make_optimizer_from(tc)
+    schedule = training_schedule(tc.lr, tc.num_steps)
+    abstract = jax.eval_shape(
+        lambda: create_state(jax.random.PRNGKey(0), cfg, tc))
+    halo_fn = halo.make_halo_train_fn(cfg, tc, mesh, abstract.params,
+                                      remat_mode=tc.remat)
+    state_sh = state_sharding(mesh, abstract)
+    repl = replicated_sharding(mesh)
+    data = batch_input_sharding(mesh)  # P('data', 'seq') on seq meshes
+
+    def step(state: TrainState, batch: Batch):
+        rng, _ = jax.random.split(state.rng)
+        loss, metrics, grads = halo_fn(
+            state.params, state.batch_stats, batch["image1"],
+            batch["image2"], batch["flow"], batch["valid"])
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            batch_stats=state.batch_stats,
+            opt_state=opt_state,
+            rng=rng,
+        )
+        metrics = dict(metrics, loss=loss, lr=schedule(state.step),
+                       state_finite=all_finite(params, state.batch_stats,
+                                               opt_state))
+        return new_state, metrics
+
+    return jax.jit(
+        step,
+        in_shardings=(state_sh, data),
+        out_shardings=(state_sh, repl),
+        donate_argnums=0,
+    )
+
+
 def make_eval_step(
     cfg: RAFTConfig,
     iters: int = 24,
     mesh: Optional[Mesh] = None,
+    compute_sharding: str = "fence",
 ) -> Callable[..., Tuple[jax.Array, jax.Array]]:
     """Jitted test-mode forward: (flow_low, flow_up) like core/raft.py:194-197.
 
@@ -309,8 +408,21 @@ def make_eval_step(
     be called POSITIONALLY with all six arguments (jit rejects kwargs
     when in_shardings is set) — mesh=None keeps the kwarg-friendly
     reference behavior.
+
+    ``compute_sharding="halo"`` swaps in the shard_map'd row-slab
+    forward (parallel/halo.make_halo_eval_fn): image rows shard over
+    the mesh's 'seq' axis and params stay in fsdp storage layout
+    through compute. That step's signature differs — (variables,
+    image1, image2, flow_init), positional, no edge arguments (v1
+    only) and flow_init always materialized (zeros = cold start) —
+    because its in_shardings pin the halo contract, not the engine's.
     """
+    if compute_sharding not in ("fence", "halo"):
+        raise ValueError(f"compute_sharding must be fence|halo, "
+                         f"got {compute_sharding!r}")
     model = RAFT(cfg)
+    if compute_sharding == "halo":
+        return _make_halo_eval_step(cfg, iters, mesh, model)
 
     def step(
         variables: Dict[str, Any],
@@ -343,6 +455,56 @@ def make_eval_step(
     return jax.jit(
         step,
         in_shardings=(repl, data, data, data, data, data),
+        out_shardings=(data, data),
+    )
+
+
+def _make_halo_eval_step(
+    cfg: RAFTConfig,
+    iters: int,
+    mesh: Optional[Mesh],
+    model: RAFT,
+) -> Callable[..., Tuple[jax.Array, jax.Array]]:
+    """The compute_sharding="halo" eval step (make_eval_step dispatches
+    here): (variables, image1, image2, flow_init) -> (flow_low,
+    flow_up), all batch leaves row-sharded over (data, seq), variables
+    pinned to their STORAGE layout (params per param_leaf_spec,
+    batch_stats replicated — layout.variables_sharding), so fsdp-stored
+    checkpoints evaluate without a host-side gather. The abstract
+    model.init costs one host-side trace; its variables tree is what
+    the sharding pins resolve against, and it matches any checkpoint of
+    the same config by construction."""
+    if mesh is None or not LAYOUT.has_seq(mesh):
+        raise ValueError(
+            "compute_sharding='halo' needs a mesh with a 'seq' axis — "
+            "build one with make_mesh_fsdp(n_data, n_fsdp, n_seq) or "
+            "make_mesh_2d(n_data, n_seq)")
+    n_seq = LAYOUT.seq_size(mesh)
+    h = 8 * n_seq * 3  # smallest halo-legal geometry; params are
+    w = 64             # size-independent (fully convolutional)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, h, w, 3), jnp.float32),
+                           jnp.zeros((1, h, w, 3), jnp.float32),
+                           iters=1, train=False, test_mode=True))
+    halo_fn = halo.make_halo_eval_fn(cfg, mesh, abstract["params"],
+                                     iters=iters)
+    var_sh = variables_sharding(mesh, abstract)
+    data = batch_input_sharding(mesh)  # P('data', 'seq')
+
+    def step(
+        variables: Dict[str, Any],
+        image1: jax.Array,
+        image2: jax.Array,
+        flow_init: jax.Array,
+    ):
+        stats = variables.get("batch_stats", {})
+        return halo_fn(variables["params"], stats, image1, image2,
+                       flow_init)
+
+    return jax.jit(
+        step,
+        in_shardings=(var_sh, data, data, data),
         out_shardings=(data, data),
     )
 

@@ -12,14 +12,15 @@ Wired into the tier-1 verify command right after lint_gate.py
 (ROADMAP.md).
 
 Usage:
-  python scripts/shard_audit.py                  # gate: diff vs BOTH
+  python scripts/shard_audit.py                  # gate: diff vs ALL
                                                  # goldens (incl. the
-                                                 # fsdp leg)
-  python scripts/shard_audit.py --write-golden   # regenerate both
+                                                 # fsdp and halo legs)
+  python scripts/shard_audit.py --write-golden   # regenerate all three
                                                  # (review the diff in
                                                  # the PR!)
   python scripts/shard_audit.py --steps serve    # partial (faster) audit
   python scripts/shard_audit.py --steps train_fsdp  # fsdp leg only
+  python scripts/shard_audit.py --steps train_halo  # halo leg only
   python scripts/shard_audit.py --json           # dump the full report
 
 Exit codes: 0 clean, 1 drift or a flagged replicated group.
@@ -49,11 +50,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser("shard_audit")
     ap.add_argument("--steps",
                     default="train,eval,serve,serve_encode,serve_refine,"
-                            "train_fsdp",
+                            "train_fsdp,train_halo",
                     help="comma-separated subset of train,eval,serve,"
-                         "serve_encode,serve_refine,train_fsdp (partial "
-                         "runs diff only their sections; train_fsdp "
-                         "diffs the fsdp golden; serve_encode/"
+                         "serve_encode,serve_refine,train_fsdp,"
+                         "train_halo (partial runs diff only their "
+                         "sections; train_fsdp diffs the fsdp golden, "
+                         "train_halo the halo one — the "
+                         "compute_sharding='halo' step; serve_encode/"
                          "serve_refine are the split-model streaming "
                          "signatures)")
     ap.add_argument("--golden", default=None,
@@ -62,8 +65,11 @@ def main(argv=None) -> int:
     ap.add_argument("--fsdp-golden", default=None,
                     help="fsdp golden path (default: dexiraft_tpu/"
                          "analysis/layout_golden_fsdp.json)")
+    ap.add_argument("--halo-golden", default=None,
+                    help="halo golden path (default: dexiraft_tpu/"
+                         "analysis/layout_golden_halo.json)")
     ap.add_argument("--write-golden", action="store_true",
-                    help="regenerate BOTH goldens from this run (always "
+                    help="regenerate ALL goldens from this run (always "
                          "audits ALL steps)")
     ap.add_argument("--threshold-mb", type=float, default=None,
                     help="replicated-array size tripwire (default 64)")
@@ -79,10 +85,12 @@ def main(argv=None) -> int:
 
     golden_path = args.golden or shardaudit.GOLDEN_PATH
     fsdp_golden_path = args.fsdp_golden or shardaudit.FSDP_GOLDEN_PATH
+    halo_golden_path = args.halo_golden or shardaudit.HALO_GOLDEN_PATH
     threshold = (args.threshold_mb if args.threshold_mb is not None
                  else shardaudit.DEFAULT_THRESHOLD_MB)
     steps = [s for s in args.steps.split(",") if s]
-    known = set(shardaudit.STEP_AUDITS) | set(shardaudit.FSDP_STEP_AUDITS)
+    known = (set(shardaudit.STEP_AUDITS) | set(shardaudit.FSDP_STEP_AUDITS)
+             | set(shardaudit.HALO_STEP_AUDITS))
     unknown = set(steps) - known
     if unknown:
         ap.error(f"unknown steps {sorted(unknown)}; "
@@ -91,6 +99,7 @@ def main(argv=None) -> int:
         steps = sorted(known)
     main_steps = [s for s in steps if s in shardaudit.STEP_AUDITS]
     fsdp_steps = [s for s in steps if s in shardaudit.FSDP_STEP_AUDITS]
+    halo_steps = [s for s in steps if s in shardaudit.HALO_STEP_AUDITS]
 
     # (report, golden path, label) per golden file in play — the fsdp
     # leg diffs its own golden so the data x seq one never drifts when
@@ -104,6 +113,10 @@ def main(argv=None) -> int:
         legs.append((shardaudit.run_audit_fsdp(fsdp_steps,
                                                threshold_mb=threshold),
                      fsdp_golden_path, "fsdp"))
+    if halo_steps:
+        legs.append((shardaudit.run_audit_halo(halo_steps,
+                                               threshold_mb=threshold),
+                     halo_golden_path, "halo"))
 
     if args.json:
         print(json.dumps({label: rep for rep, _, label in legs},

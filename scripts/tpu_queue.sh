@@ -107,7 +107,7 @@ run_all() {
   #    compile-only memory_analysis (running it for real would OOM and
   #    can wedge the relay tunnel for the rest of the queue)
   run train_remat_lookup 1200 python scripts/train_bench.py --variant v5 --batch 6 --remat_lookup
-  run train_remat   1200 python scripts/train_bench.py --variant v5 --batch 6 --remat
+  run train_remat   1200 python scripts/train_bench.py --variant v5 --batch 6 --remat per_iter
   run train_noremat 600  python scripts/train_bench.py --variant v5 --batch 6 --mem_only
   # 3. Pallas kernel on real hardware: compile + parity + sweep (next-5)
   run tpu_smoke     900 python scripts/tpu_smoke.py

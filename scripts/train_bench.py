@@ -13,8 +13,21 @@ batch*H*W*iters per second), prefetch-stall time (≈0 after warmup when
 the host keeps ahead), whole-step FLOPs + MFU, and compile time (watch
 it collapse on the second identical launch).
 
+Compute sharding (`--compute_sharding halo` + `--seq N`): runs the
+explicit shard_map spatial partitioning (parallel/halo.py) instead of
+the GSPMD gather-fence step — rows shard over the mesh's seq axis with
+ppermute halo exchange, params stay fsdp-sharded through compute via
+per-block all-gather. The record gains memory_analysis columns
+(argument/temp bytes per device) so the fence-vs-halo A/B shows the
+activation and peak-params HBM win, and `--mem_only` emits the same
+columns as a JSON record without executing. `--remat` selects the
+rematerialization policy (none | dots_saveable | per_iter; TrainConfig
+.remat) for both step modes.
+
 Usage: python scripts/train_bench.py [--variant v1|v5] [--batch 6]
            [--accum 2] [--precision bf16] [--prefetch 2] [--steps 8]
+           [--remat none|dots_saveable|per_iter] [--fsdp 2] [--seq 2]
+           [--compute_sharding fence|halo] [--freeze_bn] [--mem_only]
            [--no_compile_cache] [--cpu]
 """
 
@@ -71,7 +84,13 @@ def main():
                          "0 disables)")
     ap.add_argument("--steps", type=int, default=5,
                     help="timed steady-state steps")
-    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "dots_saveable", "per_iter"],
+                    help="rematerialization policy (TrainConfig.remat): "
+                         "per_iter recomputes each RAFT iteration in the "
+                         "backward (the old --remat flag), dots_saveable "
+                         "keeps matmul/conv outputs but recomputes "
+                         "elementwise chains")
     ap.add_argument("--remat_lookup", action="store_true")
     ap.add_argument("--corr_impl", default="allpairs",
                     choices=["allpairs", "local", "pallas", "flash"])
@@ -105,6 +124,23 @@ def main():
                          "the record's state_bytes_per_device shows "
                          "the storage win; 1 = replicated mesh "
                          "baseline for the A/B")
+    ap.add_argument("--compute_sharding", default="fence",
+                    choices=["fence", "halo"],
+                    help="'fence' = GSPMD step with one-shot entry "
+                         "all-gather of fsdp params; 'halo' = explicit "
+                         "shard_map spatial partitioning over the seq "
+                         "axis with per-conv halo exchange and per-block "
+                         "param gather (needs --seq >= 2; v1/fp32 only, "
+                         "see parallel/halo.check_halo_support)")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="shard image rows N-way over a mesh 'seq' axis "
+                         "(needs an explicit integer --fsdp; use "
+                         "--fsdp 1 for seq-only). Height must divide by "
+                         "8*N for --compute_sharding halo")
+    ap.add_argument("--freeze_bn", action="store_true",
+                    help="freeze BatchNorm stats (TrainConfig.freeze_bn "
+                         "— post-chairs stages do; required by halo on "
+                         "non-small variants)")
     ap.add_argument("--host_devices", type=int, default=None,
                     help="force N virtual host devices (CPU) so the "
                          "fsdp A/B runs without a TPU; must be the "
@@ -125,15 +161,36 @@ def main():
 
     # --fsdp enables the mesh path: state stored sharded between steps
     # (parallel/layout.state_sharding), gathered inside the step's
-    # fences; --fsdp 1 is the replicated-mesh baseline of the A/B
+    # fences (or per block inside the halo body); --fsdp 1 is the
+    # replicated-mesh baseline of the A/B. --seq adds the spatial axis
+    # halo compute sharding partitions over.
     mesh = None
     fsdp_live = False
-    if args.fsdp is not None:
+    if args.seq is not None and args.seq > 1:
+        from dexiraft_tpu.parallel.layout import LAYOUT, make_mesh_fsdp
+
+        if not isinstance(args.fsdp, int):
+            ap.error("--seq needs an explicit integer --fsdp "
+                     "(--fsdp 1 for a (data, seq)-shaped budget)")
+        budget = len(jax.devices()) // (args.fsdp * args.seq)
+        if budget < 1:
+            ap.error(f"mesh fsdp={args.fsdp} x seq={args.seq} needs "
+                     f"{args.fsdp * args.seq} devices, have "
+                     f"{len(jax.devices())} (pass --host_devices N)")
+        n_data = max(n for n in range(1, budget + 1)
+                     if args.batch % n == 0)
+        mesh = make_mesh_fsdp(n_data, args.fsdp, args.seq)
+        fsdp_live = LAYOUT.has_fsdp(mesh)
+        print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
+    elif args.fsdp is not None:
         from dexiraft_tpu.parallel.layout import LAYOUT, make_train_mesh
 
         mesh = make_train_mesh(args.batch, fsdp=args.fsdp)
         fsdp_live = LAYOUT.has_fsdp(mesh)
         print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
+    if args.compute_sharding == "halo" and (args.seq or 0) < 2:
+        ap.error("--compute_sharding halo needs --seq >= 2 (the halo "
+                 "step partitions rows over the mesh's seq axis)")
 
     cache_dir = None
     if not args.no_compile_cache and fsdp_live:
@@ -153,18 +210,20 @@ def main():
     # fp32-vs-bf16 A/B compares genuinely different programs (the step
     # forces mixed_precision=True itself when precision=bf16)
     cfg = getattr(C, f"raft_{args.variant}")(
-        mixed_precision=args.precision == "bf16", remat=args.remat,
+        mixed_precision=args.precision == "bf16",
         remat_lookup=args.remat_lookup, corr_impl=args.corr_impl,
         corr_dtype=args.corr_dtype, fused_update=args.fused_update)
     h, w = args.size
     tc = TrainConfig(name="bench", num_steps=1000, batch_size=args.batch,
                      image_size=(h, w), iters=args.iters, lr=4e-4,
                      precision=args.precision, accum_steps=args.accum,
-                     prefetch_depth=args.prefetch)
+                     prefetch_depth=args.prefetch, remat=args.remat,
+                     freeze_bn=args.freeze_bn)
     print(f"platform={jax.devices()[0].platform} variant={args.variant} "
           f"batch={args.batch} {h}x{w} iters={args.iters} "
           f"precision={args.precision} accum={args.accum} "
-          f"prefetch={args.prefetch}", file=sys.stderr)
+          f"prefetch={args.prefetch} remat={args.remat} "
+          f"compute_sharding={args.compute_sharding}", file=sys.stderr)
 
     t0 = time.perf_counter()
     state = create_state(jax.random.PRNGKey(0), cfg, tc)
@@ -172,9 +231,37 @@ def main():
         from dexiraft_tpu.parallel.layout import shard_state
 
         state = shard_state(state, mesh)
-    step_fn = make_train_step(cfg, tc, mesh=mesh)
+    step_fn = make_train_step(cfg, tc, mesh=mesh,
+                              compute_sharding=args.compute_sharding)
     init_s = time.perf_counter() - t0
     print(f"init {init_s:.1f}s", file=sys.stderr)
+
+    def mem_fields(compiled_exe):
+        """memory_analysis of the per-device compiled module — the HBM
+        columns of the record. argument bytes carry the fsdp storage
+        win (params arrive sharded), temp bytes carry the halo
+        activation win (spatial slabs shard over seq) AND the per-block
+        gather win (peak gathered params = one block, not the tree).
+        Best-effort: absent on backends without the analysis."""
+        try:
+            mem = compiled_exe.memory_analysis()
+        except Exception as e:
+            print(f"memory_analysis unavailable: {e}", file=sys.stderr)
+            return {}
+        out = {}
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes", "alias_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[attr.replace("_size_in_bytes",
+                                 "_bytes_per_device")] = int(v)
+        total = (out.get("argument_bytes_per_device", 0)
+                 + out.get("output_bytes_per_device", 0)
+                 + out.get("temp_bytes_per_device", 0)
+                 - out.get("alias_bytes_per_device", 0))
+        out["hbm_bytes_per_device"] = total
+        return out
 
     def host_batches():
         # a PRE-DECODED pool, cycled: the real Loader hands over batches
@@ -201,34 +288,39 @@ def main():
         # compile WITHOUT executing: the memory_analysis of the
         # executable is the OOM proof (requirements vs the chip limit)
         # with no allocation and so no tunnel-wedging OOM crash
-        batch = jax.tree.map(jnp.asarray, next(host_batches()))
+        if mesh is not None:
+            from dexiraft_tpu.parallel.layout import batch_putter
+
+            batch = batch_putter(mesh)(next(host_batches()))
+        else:
+            batch = jax.tree.map(jnp.asarray, next(host_batches()))
         t0 = time.perf_counter()
         compiled = step_fn.lower(state, batch).compile()
         print(f"compile-only {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
-        try:
-            mem = compiled.memory_analysis()
-            for attr in ("argument_size_in_bytes", "output_size_in_bytes",
-                         "temp_size_in_bytes", "alias_size_in_bytes",
-                         "generated_code_size_in_bytes"):
-                v = getattr(mem, attr, None)
-                if v is not None:
-                    print(f"{attr}: {v / 2**30:.2f} GiB")
-            total = sum(getattr(mem, a, 0) or 0
-                        for a in ("argument_size_in_bytes",
-                                  "output_size_in_bytes",
-                                  "temp_size_in_bytes"))
-            total -= getattr(mem, "alias_size_in_bytes", 0) or 0
-            print(f"total (args+out+temp-alias): {total / 2**30:.2f} GiB")
-        except Exception as e:
-            print(f"memory_analysis unavailable: {e}", file=sys.stderr)
+        fields = mem_fields(compiled)
+        for k, v in fields.items():
+            print(f"{k}: {v / 2**30:.2f} GiB", file=sys.stderr)
+        record = {
+            "metric": f"train_step_memory@{h}x{w}",
+            "platform": jax.devices()[0].platform,
+            "variant": args.variant,
+            "batch": args.batch,
+            "iters": args.iters,
+            "precision": args.precision,
+            "remat": args.remat,
+            "compute_sharding": args.compute_sharding,
+            "mesh": dict(mesh.shape) if mesh is not None else None,
+            **fields,
+        }
         try:
             stats = jax.local_devices()[0].memory_stats() or {}
             limit = stats.get("bytes_limit")
             if limit:
-                print(f"chip bytes_limit: {limit / 2**30:.2f} GiB")
+                record["chip_bytes_limit"] = int(limit)
         except Exception:
             pass
+        print(json.dumps(record), flush=True)
         return
 
     pf = prefetch_to_device(host_batches(), mesh, depth=args.prefetch)
@@ -348,6 +440,9 @@ def main():
         "precision": args.precision,
         "accum_steps": args.accum,
         "prefetch_depth": args.prefetch,
+        "remat": args.remat,
+        "compute_sharding": args.compute_sharding,
+        "loss": round(float(jax.device_get(metrics["loss"])), 6),
         # backend compile when cached (AOT-timed); compile+first-step
         # combined when --no_compile_cache
         "compile_s": round(compile_s, 2),
@@ -359,6 +454,9 @@ def main():
         "prefetch_stalled_steps": pf.stats.stalls,
         "mesh": dict(mesh.shape) if mesh is not None else None,
         "state_bytes_per_device": state_bytes_per_device(),
+        # HBM columns (when the AOT executable exists — the cached
+        # path; uncached runs get them from --mem_only instead)
+        **(mem_fields(compiled) if compiled is not None else {}),
         **report.fields(dt, flops, peak),
     }
     if flops and peak is None:

@@ -5,6 +5,7 @@ train step matching the 1-D data-parallel step numerically."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dexiraft_tpu.config import TrainConfig, raft_v1
 from dexiraft_tpu.ops.corr import build_corr_pyramid, corr_lookup
@@ -90,6 +91,14 @@ class TestRingCorrLookup:
 
 
 class TestSpatiallyShardedTrainStep:
+    @pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="GSPMD miscompiles spatially-partitioned convolutions on the "
+               "CPU backend: the fence train step on a mesh with a 'seq' "
+               "axis computes a wrong loss (same class as the feature-dim "
+               "conv miscompile in docs/perf.md; see docs/parallel.md). "
+               "compute_sharding='halo' sidesteps GSPMD conv partitioning "
+               "entirely and is parity-pinned in tests/test_zzzhalo.py.")
     def test_2d_mesh_matches_1d(self):
         cfg = raft_v1(small=True)
         tc = TrainConfig(name="cp", num_steps=10, batch_size=4,
@@ -120,6 +129,7 @@ class TestSpatiallyShardedTrainStep:
 
 
 class TestSpatiallyShardedEval:
+    @pytest.mark.slow
     def test_sharded_eval_matches_unsharded(self):
         """Long-context inference: the test-mode forward with inputs
         sharded over a (data, seq) mesh — batch over 'data', image rows

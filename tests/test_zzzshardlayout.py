@@ -238,6 +238,8 @@ class TestSpecLayout:
         assert spec_str(LAYOUT.opt_state()) == "P()"
         assert spec_str(LAYOUT.batch()) == "P('data')"
         assert spec_str(LAYOUT.batch_spatial()) == "P('data', 'seq')"
+        assert spec_str(LAYOUT.batch_spatial_compute()) == \
+            "P('data', 'seq')"
         assert spec_str(LAYOUT.carry()) == "P('data')"
         assert spec_str(LAYOUT.corr_query_rows()) == \
             "P(None, 'seq', None, None)"
@@ -249,10 +251,11 @@ class TestSpecLayout:
         from dexiraft_tpu.parallel.layout import SpecLayout
 
         expected = {"replicated", "params", "opt_state", "fsdp_params",
-                    "param_leaf_spec", "batch", "batch_spatial", "carry",
+                    "param_leaf_spec", "batch", "batch_spatial",
+                    "batch_spatial_compute", "carry",
                     "corr_query_rows", "batch_for", "corr_volume",
                     "corr_fmaps", "data_size", "has_seq", "has_fsdp",
-                    "fsdp_size"}
+                    "fsdp_size", "seq_size"}
         public = {n for n in dir(SpecLayout) if not n.startswith("_")
                   and callable(getattr(SpecLayout, n))}
         assert public == expected
@@ -273,6 +276,7 @@ class TestSpecLayout:
         assert spec_str(LAYOUT.corr_fmaps(m2)) == "P('data', 'seq')"
         assert LAYOUT.data_size(m2) == 4
         assert LAYOUT.has_seq(m2) and not LAYOUT.has_seq(m1)
+        assert LAYOUT.seq_size(m2) == 2 and LAYOUT.seq_size(m1) == 1
 
     def test_make_train_mesh_policy(self):
         """The glue that used to live inline in train_cli: largest
@@ -424,10 +428,16 @@ class TestAuditCLI:
 
     @staticmethod
     def _patch_fsdp(monkeypatch):
+        # the fsdp AND halo legs answer from their goldens so the CLI
+        # tests exercise gate plumbing, not three step compiles
         fsdp_golden = shardaudit.load_golden(shardaudit.FSDP_GOLDEN_PATH)
         monkeypatch.setattr(
             shardaudit, "run_audit_fsdp",
             lambda steps, threshold_mb: copy.deepcopy(fsdp_golden))
+        halo_golden = shardaudit.load_golden(shardaudit.HALO_GOLDEN_PATH)
+        monkeypatch.setattr(
+            shardaudit, "run_audit_halo",
+            lambda steps, threshold_mb: copy.deepcopy(halo_golden))
 
     def test_clean_report_exits_zero(self, monkeypatch):
         main = self._main()
