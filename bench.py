@@ -66,6 +66,49 @@ def validate_record(rec: dict) -> None:
             raise ValueError(f"bench record carries unpinned key {key!r}; "
                              "extend BENCH_RECORD_KEYS (and the schema "
                              "test) deliberately, not by accident")
+
+# ---- stderr relay hygiene (shared with scripts/serve_bench.py) ----------
+# On hosts whose CPU lacks features the wheels were built for, XLA prints
+# a warning line carrying this marker. Relayed verbatim by the watchdog
+# pumps it lands in the queue's recorded `tail` fields, burying the JSON
+# metric line the driver greps for. The filter diverts it: first
+# occurrence goes verbatim to a side log and is replaced by a one-line
+# note; repeats are dropped.
+XLA_HOST_WARNING_MARKER = b"This could lead to execution errors such as SIGILL"
+
+
+def make_stderr_filter(log_path=None, tag="bench"):
+    """Line filter for a watchdog stderr pump: returns fn(line: bytes)
+    -> bytes | None. Lines carrying XLA_HOST_WARNING_MARKER are diverted
+    — the first is appended verbatim to ``log_path`` (default
+    $BENCH_XLA_WARN_LOG or /tmp/xla_host_warning.log) and replaced with
+    a short note; later ones return None (drop). Everything else passes
+    through untouched, so the relayed stream still ends with the record's
+    JSON line."""
+    import os
+
+    path = log_path or os.environ.get("BENCH_XLA_WARN_LOG",
+                                      "/tmp/xla_host_warning.log")
+    seen = [False]
+
+    def filt(line: bytes):
+        if XLA_HOST_WARNING_MARKER not in line:
+            return line
+        if seen[0]:
+            return None
+        seen[0] = True
+        try:
+            with open(path, "ab") as fh:
+                fh.write(line)
+            where = path
+        except OSError:
+            where = f"unwritable {path}; warning dropped"
+        return (f"[{tag}] XLA host-feature warning suppressed "
+                f"(full text: {where})\n").encode()
+
+    return filt
+
+
 HEIGHT, WIDTH = 440, 1024  # 436 padded to /8 (core/utils/utils.py:7-19)
 # CPU fallback: the number is diagnostic only (smoke proof the model
 # runs), so spend seconds, not minutes, producing it
@@ -234,12 +277,19 @@ def _run_child(want_cpu: bool) -> tuple[int, bool]:
                      for s in (signal.SIGTERM, signal.SIGINT)}
     last = [time.monotonic()]
     json_seen = [False]
+    warn_filt = make_stderr_filter(tag="bench")
 
     def pump(src, dst, is_stdout):
         for line in iter(src.readline, b""):
             last[0] = time.monotonic()
             if is_stdout and line.lstrip().startswith(b'{"metric"'):
                 json_seen[0] = True
+            if not is_stdout:
+                # keep the XLA host-feature warning out of the relayed
+                # stream (and thus the queue's recorded tail)
+                line = warn_filt(line)
+                if line is None:
+                    continue
             dst.buffer.write(line)
             dst.flush()
 

@@ -137,6 +137,15 @@ class RAFTConfig:
     # lookup's hat-matrix build with the current GRU) at the cost of
     # code-size/compile time. Numerically identical; eval-latency knob
     scan_unroll: int = 1
+    # convergence gate for the ADAPTIVE inference path (models/raft.py
+    # adaptive=True): an item freezes once the mean per-pixel L2 norm of
+    # its 1/8-res flow delta drops below this. 0.0 disables the gate
+    # (the norm is >= 0, so `norm < 0` never fires) — the while_loop
+    # then runs exactly `iter_budget` iterations and is bit-exact with
+    # the fixed scan at the same count (pinned in tests). The default
+    # is the EPE-vs-latency frontier point measured in docs/perf.md:
+    # within 0.05 px of fixed-32 at >= 25% fewer mean iterations
+    converge_tol: float = 0.02
 
     def __post_init__(self):
         # config-time refusals (ISSUE 12 satellite): an unknown
@@ -162,6 +171,10 @@ class RAFTConfig:
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; expected "
                 "'full' or 'dots_saveable'")
+        if self.converge_tol < 0:
+            raise ValueError(
+                f"converge_tol must be >= 0 (a flow-delta NORM threshold; "
+                f"0 disables the gate), got {self.converge_tol}")
 
     @property
     def radius(self) -> int:

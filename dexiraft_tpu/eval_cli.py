@@ -58,6 +58,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="embedded-DexiNed upsampler implementation "
                         "(numerically identical; see docs/perf.md)")
     p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--adaptive", action="store_true",
+                   help="convergence-gated adaptive inference: the "
+                        "refinement runs a while_loop that freezes each "
+                        "item once its flow-delta norm drops below "
+                        "converge_tol (docs/serving.md \"Adaptive "
+                        "iterations\"); --iters becomes the budget CAP")
+    p.add_argument("--converge_tol", type=float, default=None,
+                   help="override RAFTConfig.converge_tol (mean 1/8-res "
+                        "flow-delta norm below which an item stops "
+                        "refining; 0 disables the gate — bit-exact "
+                        "fixed-iteration parity)")
+    p.add_argument("--adaptive_iters", default=None,
+                   help="comma-separated iteration budgets (e.g. "
+                        "4,8,16,32): runs the fixed baseline at --iters "
+                        "plus the adaptive driver at each budget and "
+                        "emits ONE EPE-vs-latency frontier JSON record "
+                        "(docs/perf.md \"Adaptive-iteration frontier\")")
+    p.add_argument("--frontier_out", default=None,
+                   help="also write the --adaptive_iters frontier "
+                        "record to this path")
     p.add_argument("--output", default=None, help="submission output dir")
     # engine knobs via the ONE shared surface (serve.engine
     # add_engine_args / ServeConfig.from_args) so the batch-eval and
@@ -107,6 +127,12 @@ def load_variables(args):
                                  fused_update=fused,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
+    if getattr(args, "converge_tol", None) is not None:
+        import dataclasses
+
+        # checkpoint-compatible: the gate threshold shapes no params,
+        # only the adaptive driver's exit condition
+        cfg = dataclasses.replace(cfg, converge_tol=args.converge_tol)
     template = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     state = ckpt.restore_checkpoint(args.model, template)
     return cfg, state.variables
@@ -127,14 +153,25 @@ def _serving(args) -> bool:
     return args.serve or args.batch_size > 1 or args.data_parallel > 0
 
 
-def _make_eval_fn(args, cfg, variables, iters):
+def _make_eval_fn(args, cfg, variables, iters, adaptive=None):
     """Uniform eval-fn: (im1, im2, flow_init) — POSITIONAL-safe for the
     engine (the mesh path pins in_shardings, which rejects kwargs) and
     kwarg-friendly for the per-image loops. Sintel and KITTI now share
     one signature: flow_init=None is always accepted (the KITTI model
-    simply never receives a warm start)."""
+    simply never receives a warm start).
+
+    Adaptive (default: args.adaptive) grows the trailing ``iter_budget``
+    and the 4-tuple return (the ADAPTIVE engine contract in
+    serve/engine.py): a ``None`` budget resolves to the full ``iters``
+    HERE, normalized to the same np.int32 aval the engine's scheduler
+    dispatches use — every budget value rides ONE traced scalar, so one
+    executable per bucket serves them all."""
+    import numpy as np
+
     from dexiraft_tpu.train.step import make_eval_step
 
+    if adaptive is None:
+        adaptive = getattr(args, "adaptive", False)
     mesh = None
     if args.data_parallel > 0:
         from dexiraft_tpu.parallel.layout import make_serve_mesh, replicate
@@ -143,7 +180,7 @@ def _make_eval_fn(args, cfg, variables, iters):
         # replicate once up front — the pinned replicated in_sharding
         # would otherwise re-transfer the params on every dispatch
         variables = replicate(variables, mesh)
-    step = make_eval_step(cfg, iters=iters, mesh=mesh)
+    step = make_eval_step(cfg, iters=iters, mesh=mesh, adaptive=adaptive)
     if mesh is None:
         # explicit H2D put (jaxlint/guards): callers hand numpy frames;
         # device_put keeps the transfer visible and legal under the
@@ -153,12 +190,67 @@ def _make_eval_fn(args, cfg, variables, iters):
         # implicit (guard-tripping) put on every frame.
         variables = jax.device_put(variables)
         put = jax.device_put
+        if adaptive:
+            return (lambda im1, im2, flow_init=None, iter_budget=None:
+                    step(variables, put(im1), put(im2),
+                         flow_init=(None if flow_init is None
+                                    else put(flow_init)),
+                         iter_budget=np.int32(
+                             iters if iter_budget is None
+                             else iter_budget))), None
         return (lambda im1, im2, flow_init=None:
                 step(variables, put(im1), put(im2),
                      flow_init=(None if flow_init is None
                                 else put(flow_init)))), None
+    if adaptive:
+        return (lambda im1, im2, flow_init=None, iter_budget=None:
+                step(variables, im1, im2, None, None, flow_init,
+                     np.int32(iters if iter_budget is None
+                              else iter_budget))), mesh
     return (lambda im1, im2, flow_init=None:
             step(variables, im1, im2, None, None, flow_init)), mesh
+
+
+# ---- adaptive frontier record schema, pinned by
+# tests/test_zzzadaptive.py -----------------------------------------------
+FRONTIER_RECORD_KEYS = {
+    "record", "dataset", "iters", "converge_tol", "fixed", "sweep",
+}
+# every sweep leg carries the dataset's metric keys plus these
+FRONTIER_LEG_KEYS = {
+    "budget", "wall_s", "mean_iters_used", "p99_iters_used",
+    "mean_final_delta",
+}
+
+
+def _adaptive_pair_view(eval_fn, sink=None):
+    """Adapt the adaptive 4-tuple eval fn to the (flow_low, flow_up)
+    contract of the per-image loops (eval.validate/_run unpacks exactly
+    two). iters_used/final_delta land in ``sink`` (a list of per-call
+    (iters_used, final_delta) host arrays) when one is given."""
+
+    def fn(im1, im2, flow_init=None):
+        flow_low, flow_up, iters_used, final_delta = eval_fn(
+            im1, im2, flow_init)
+        if sink is not None:
+            # explicit D2H (jaxlint JL007) — (B,) scalars per call
+            sink.append((jax.device_get(iters_used),
+                         jax.device_get(final_delta)))
+        return flow_low, flow_up
+
+    return fn
+
+
+def _sink_summary(sink) -> dict:
+    import numpy as np
+
+    used = np.concatenate([np.atleast_1d(iu) for iu, _ in sink])
+    deltas = np.concatenate([np.atleast_1d(fd) for _, fd in sink])
+    return {
+        "mean_iters_used": round(float(used.mean()), 2),
+        "p99_iters_used": round(float(np.percentile(used, 99)), 2),
+        "mean_final_delta": round(float(deltas.mean()), 6),
+    }
 
 
 def _make_engine(args, eval_fn, mesh, mode, warm_start=False, watch=None):
@@ -237,6 +329,9 @@ def main(argv=None) -> None:
 
 
 def _run_eval(args, cfg, variables, watch) -> None:
+    if args.adaptive_iters:
+        _adaptive_sweep(args, cfg, variables)
+        return
     if args.dataset:
         from dexiraft_tpu.eval.validate import run_validation
 
@@ -249,15 +344,29 @@ def _run_eval(args, cfg, variables, watch) -> None:
         iters = args.iters or _VAL_ITERS.get(args.dataset, 24)
         eval_fn, mesh = _make_eval_fn(args, cfg, variables, iters)
         engine = None
+        sink: list = []
         if _serving(args):
             mode = "kitti" if args.dataset in ("kitti", "hd1k") else "sintel"
             engine = _make_engine(args, eval_fn, mesh, mode, watch=watch)
-        elif watch is not None:
-            eval_fn = _strict_wrap(eval_fn, watch)
+        else:
+            if args.adaptive:
+                eval_fn = _adaptive_pair_view(eval_fn, sink)
+            if watch is not None:
+                eval_fn = _strict_wrap(eval_fn, watch)
         run_validation(args.dataset, eval_fn, dataset,
                        batch_size=args.batch_size, engine=engine)
         if engine is not None:
             print(f"engine: {engine.stats.summary()}")
+            if engine.config.adaptive:
+                print(f"adaptive: mean iters_used "
+                      f"{engine.stats.iters_used_mean():.1f} / "
+                      f"p99 {engine.stats.iters_used_pctl(99):.0f} "
+                      f"(budget {iters})")
+        elif sink:
+            s = _sink_summary(sink)
+            print(f"adaptive: mean iters_used {s['mean_iters_used']} / "
+                  f"p99 {s['p99_iters_used']} (budget {iters}), "
+                  f"mean final delta {s['mean_final_delta']}")
 
     if args.submission == "sintel":
         from dexiraft_tpu.eval.submission import create_sintel_submission
@@ -266,6 +375,8 @@ def _run_eval(args, cfg, variables, watch) -> None:
         engine = (_make_engine(args, eval_fn, mesh, "sintel",
                                warm_start=args.warm_start, watch=watch)
                   if _serving(args) else None)
+        if engine is None and args.adaptive:
+            eval_fn = _adaptive_pair_view(eval_fn)
         if engine is None and watch is not None:
             eval_fn = _strict_wrap(eval_fn, watch)
         create_sintel_submission(
@@ -280,6 +391,8 @@ def _run_eval(args, cfg, variables, watch) -> None:
         eval_fn, mesh = _make_eval_fn(args, cfg, variables, args.iters or 24)
         engine = (_make_engine(args, eval_fn, mesh, "kitti", watch=watch)
                   if _serving(args) else None)
+        if engine is None and args.adaptive:
+            eval_fn = _adaptive_pair_view(eval_fn)
         if engine is None and watch is not None:
             eval_fn = _strict_wrap(eval_fn, watch)
         create_kitti_submission(
@@ -287,6 +400,79 @@ def _run_eval(args, cfg, variables, watch) -> None:
             output_path=args.output or "kitti_submission",
             batch_size=args.batch_size,
             engine=engine)
+
+
+def _adaptive_sweep(args, cfg, variables) -> None:
+    """The EPE-vs-latency frontier protocol (docs/perf.md): ONE fixed
+    baseline at --iters plus the adaptive driver at each budget in
+    --adaptive_iters, all over the same dataset in the same process.
+    Emits one self-describing JSON record (stdout, and --frontier_out).
+
+    Per-image loop on purpose (no engine/batching): the legs differ
+    only in the refinement driver, so their wall-clocks are directly
+    comparable and the per-item iters_used samples are exact.
+    """
+    import json
+    import time
+
+    from dexiraft_tpu.eval.validate import run_validation
+
+    if not args.dataset:
+        raise SystemExit("--adaptive_iters needs --dataset")
+    budgets = [int(tok) for tok in args.adaptive_iters.split(",")
+               if tok.strip()]
+    if not budgets:
+        raise SystemExit(f"--adaptive_iters parsed to no budgets: "
+                         f"{args.adaptive_iters!r}")
+    dataset = None
+    if args.dataset == "edgesum":
+        if not args.edge_root:
+            raise SystemExit("--dataset edgesum needs --edge_root")
+        dataset = _edgesum_dataset(args.edge_root)
+    iters = args.iters or _VAL_ITERS.get(args.dataset, 24)
+
+    fixed_fn, _ = _make_eval_fn(args, cfg, variables, iters,
+                                adaptive=False)
+    t0 = time.perf_counter()
+    fixed_metrics = run_validation(args.dataset, fixed_fn, dataset)
+    fixed_wall = time.perf_counter() - t0
+
+    adaptive_fn, _ = _make_eval_fn(args, cfg, variables, iters,
+                                   adaptive=True)
+    record = {
+        "record": "adaptive_frontier",
+        "dataset": args.dataset,
+        "iters": iters,
+        "converge_tol": cfg.converge_tol,
+        "fixed": {**fixed_metrics, "wall_s": round(fixed_wall, 2)},
+        "sweep": [],
+    }
+    for budget in budgets:
+        sink: list = []
+        fn = _adaptive_pair_view(
+            lambda im1, im2, flow_init=None, _b=budget:
+            adaptive_fn(im1, im2, flow_init, _b), sink)
+        t0 = time.perf_counter()
+        metrics = run_validation(args.dataset, fn, dataset)
+        wall = time.perf_counter() - t0
+        leg = {**metrics, "budget": budget, "wall_s": round(wall, 2)}
+        if sink:
+            leg.update(_sink_summary(sink))
+        # the frontier's decision metric: quality cost of THIS budget
+        # relative to the fixed anchor, per dataset key
+        for k, v in fixed_metrics.items():
+            if isinstance(v, float) and k in metrics:
+                leg[f"{k}_delta"] = round(metrics[k] - v, 4)
+        assert FRONTIER_LEG_KEYS <= set(leg), \
+            sorted(FRONTIER_LEG_KEYS - set(leg))
+        record["sweep"].append(leg)
+    assert set(record) == FRONTIER_RECORD_KEYS, \
+        sorted(set(record) ^ FRONTIER_RECORD_KEYS)
+    line = json.dumps(record)
+    print(line)
+    if args.frontier_out:
+        with open(args.frontier_out, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

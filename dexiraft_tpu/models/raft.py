@@ -319,6 +319,8 @@ class RAFT(nn.Module):
         mode: str = "pair",
         features1: Optional[Dict[str, Any]] = None,
         features2: Optional[Dict[str, Any]] = None,
+        adaptive: bool = False,
+        iter_budget: Optional[jax.Array] = None,
     ):
         """Estimate flow between two (B, H, W, 3) [0,255] frames.
 
@@ -332,8 +334,38 @@ class RAFT(nn.Module):
 
         Returns stacked per-iteration upsampled flows (iters, B, H, W, 2),
         or (flow_low, flow_up) in test_mode (core/raft.py:194-197).
+
+        adaptive=True (inference only): the fixed scan is replaced by a
+        lax.while_loop with a per-item convergence gate — an item
+        freezes (masked no-op update, carry preserved) once the mean
+        per-pixel L2 norm of its 1/8-res flow delta drops below
+        cfg.converge_tol, and the loop exits when every item is done or
+        ``iter_budget`` (a TRACED int32 scalar, clamped to [0, iters] —
+        one compiled executable serves every budget) expires. Returns
+        (flow_low, flow_up, iters_used[B], final_delta[B]). The train
+        path is untouched; variant='separate' is refused (its RefineFlow
+        head must stay inside the emitting scan for parameter-path
+        stability, which the non-emitting while_loop cannot host).
         """
         cfg = self.cfg
+        if adaptive:
+            if not test_mode:
+                raise ValueError(
+                    "adaptive=True is an inference path: it needs "
+                    "test_mode=True (the sequence loss consumes every "
+                    "iteration's prediction — early exit has no training "
+                    "meaning, and the scan+remat train path stays as-is)")
+            if cfg.variant == "separate":
+                raise ValueError(
+                    "adaptive=True does not support variant='separate': "
+                    "its RefineFlow fusion head lives INSIDE the scanned "
+                    "step (emit=True even in test mode, models/raft.py) "
+                    "and the adaptive while_loop drives the non-emitting "
+                    "step; use v1/v2/v4/v5 or the fixed-iters path")
+        elif iter_budget is not None:
+            raise ValueError(
+                "iter_budget only has meaning with adaptive=True (the "
+                "fixed path compiles its iteration count statically)")
         # corr_impl/corr_dtype/fused_update combinations are refused at
         # CONFIG time (RAFTConfig.__post_init__) — by the time a config
         # reaches apply() they are known-valid. Only the runtime-
@@ -431,6 +463,12 @@ class RAFT(nn.Module):
                 nb = 2 * b if cfg.has_edge_stream else b
                 carry["up_mask"] = jnp.zeros((nb, h8, w8, 64 * 9), dtype)
 
+        if adaptive:
+            # adaptive implies test_mode and not 'separate', so emit is
+            # False here and the carry already holds the up_mask slot
+            return self._adaptive_refine(carry, consts, coords0, b, iters,
+                                         iter_budget, dtype)
+
         step_cls = RAFTStep
         if cfg.remat:
             # recompute each iteration's activations in backward instead
@@ -464,3 +502,94 @@ class RAFT(nn.Module):
                 None if carry["up_mask"] is None else carry["up_mask"][:b])
             return flow_low, flow_up
         return predictions
+
+    def _adaptive_refine(self, carry, consts, coords0, b, iters,
+                         iter_budget, dtype):
+        """Convergence-gated refinement (``adaptive=True``): an
+        nn.while_loop over the SAME step module the scan path drives —
+        the module name is pinned to "ScanRAFTStep_0" with params
+        broadcast, so the parameter tree (and thus every checkpoint) is
+        identical between the two drivers.
+
+        Per-item gate: after each update, the item's flow delta at 1/8
+        res (the image stream's coords1 movement) reduces to a mean
+        per-pixel L2 norm; once it drops below cfg.converge_tol the item
+        is DONE — subsequent iterations freeze its carry rows via a
+        masked select (dual variants freeze the edge-stream row b+i
+        together with its image row i), so a converged item's result is
+        bit-identical to having stopped. The loop exits when every item
+        is done or the traced ``iter_budget`` expires; with tol=0 the
+        gate never fires (the norm is >= 0) and a full budget replays
+        the scan path's update sequence exactly.
+
+        Returns (flow_low, flow_up, iters_used[B], final_delta[B]):
+        iters_used counts the updates each item actually applied;
+        final_delta is the item's last pre-freeze delta norm (0.0 if
+        the budget was 0 and no update ever ran).
+        """
+        cfg = self.cfg
+        # no remat wrapper: this path never differentiates, and the
+        # plain module binds the same "ScanRAFTStep_0" parameter paths
+        step = RAFTStep(cfg=cfg, dtype=dtype, emit=False,
+                        name="ScanRAFTStep_0")
+
+        def finish(c, iters_used, final_delta):
+            flow_low = c["coords1"][:b] - coords0
+            flow_up = _upsample(
+                flow_low,
+                None if c["up_mask"] is None else c["up_mask"][:b])
+            return flow_low, flow_up, iters_used, final_delta
+
+        if self.is_initializing():
+            # nn.while_loop cannot create variables inside its body; one
+            # direct step call initializes the (broadcast) params — the
+            # same tree the while_loop then closes over read-only
+            c, _ = step(carry, None, consts)
+            return finish(c, jnp.zeros((b,), jnp.int32),
+                          jnp.zeros((b,), jnp.float32))
+
+        budget = iters if iter_budget is None else iter_budget
+        budget = jnp.clip(jnp.asarray(budget, jnp.int32), 0, iters)
+        tol = jnp.float32(cfg.converge_tol)
+
+        state = {
+            "carry": carry,
+            "done": jnp.zeros((b,), bool),
+            "iters_used": jnp.zeros((b,), jnp.int32),
+            "final_delta": jnp.zeros((b,), jnp.float32),
+            "it": jnp.zeros((), jnp.int32),
+        }
+
+        def cond_fn(_mdl, s):
+            return jnp.logical_and(s["it"] < budget,
+                                   jnp.any(jnp.logical_not(s["done"])))
+
+        def body_fn(mdl, s):
+            old = s["carry"]
+            new, _ = mdl(old, None, consts)
+            # the convergence signal: how far this update moved the
+            # IMAGE stream's 1/8-res flow, as a mean per-pixel L2 norm
+            d = new["coords1"][:b] - old["coords1"][:b]
+            dn = jnp.sqrt(jnp.sum(jnp.square(d), -1)).mean((1, 2))
+            active = jnp.logical_not(s["done"])
+
+            def freeze(o, n):
+                m = active
+                if n.shape[0] != b:
+                    # dual variants: the edge-stream row rides (and
+                    # freezes with) its image row
+                    m = jnp.concatenate([active, active], 0)
+                return jnp.where(m.reshape((-1,) + (1,) * (n.ndim - 1)),
+                                 n, o)
+
+            return {
+                "carry": jax.tree.map(freeze, old, new),
+                "done": jnp.logical_or(s["done"], dn < tol),
+                "iters_used": s["iters_used"] + active.astype(jnp.int32),
+                "final_delta": jnp.where(active, dn, s["final_delta"]),
+                "it": s["it"] + 1,
+            }
+
+        state = nn.while_loop(cond_fn, body_fn, step, state)
+        return finish(state["carry"], state["iters_used"],
+                      state["final_delta"])

@@ -135,6 +135,16 @@ class ServeStats:
         self.carry_d2h_bytes = 0
         self.batch_latency_s: "collections.deque" = collections.deque(
             maxlen=self.maxlen)
+        # adaptive-iteration accounting (engine adaptive mode): per-ITEM
+        # samples of how many refinement updates each real (non-pad)
+        # frame pair actually applied, and its last pre-freeze flow-delta
+        # norm — the convergence evidence the /stats adaptive block and
+        # the serve_bench frontier record serialize. Bounded like the
+        # latency window, and 0-length on fixed-iteration engines.
+        self.iters_used: "collections.deque" = collections.deque(
+            maxlen=self.maxlen)
+        self.final_delta: "collections.deque" = collections.deque(
+            maxlen=self.maxlen)
 
     def latency_ms(self, p: float) -> float:
         import numpy as np
@@ -142,6 +152,25 @@ class ServeStats:
         if not self.batch_latency_s:
             return 0.0
         return float(np.percentile(self.batch_latency_s, p)) * 1e3
+
+    def iters_used_pctl(self, p: float) -> float:
+        import numpy as np
+
+        if not self.iters_used:
+            return 0.0
+        return float(np.percentile(self.iters_used, p))
+
+    def iters_used_mean(self) -> float:
+        if not self.iters_used:
+            return 0.0
+        return sum(self.iters_used) / len(self.iters_used)
+
+    def final_delta_pctl(self, p: float) -> float:
+        import numpy as np
+
+        if not self.final_delta:
+            return 0.0
+        return float(np.percentile(self.final_delta, p))
 
     def summary(self) -> str:
         return (f"{self.batches} batches / {self.frames} frame pairs "

@@ -32,6 +32,17 @@ either None or a (B, H/8, W/8, 2) array. A flow_init row of ZEROS is
 numerically identical to no warm start (RAFT adds it to coords0), which
 is what makes per-item carry work: one batch can mix warm-started items
 and cold items without a second executable.
+
+ADAPTIVE engines (ServeConfig.adaptive): the eval_fn grows a trailing
+``iter_budget`` positional and returns (flow_low, flow_up,
+iters_used[B], final_delta[B]) — the convergence-gated while_loop path
+(train.step make_eval_step(adaptive=True)). The budget is a TRACED
+int32 scalar, so every budget value rides the bucket's ONE compiled
+executable; the engine normalizes it to np.int32 in exactly one place
+(_dispatch) so a warmup dispatch and a scheduler-budgeted dispatch can
+never present different scalar avals (= a second executable). A None
+budget means "the step's full configured iters" and is resolved by the
+eval_fn wrapper, again to the same normalized aval.
 """
 
 from __future__ import annotations
@@ -81,6 +92,10 @@ class ServeConfig:
     # carry semantics, kept for multi-worker pools and the data-parallel
     # mesh path (pinned shardings re-lay the batch out anyway).
     device_carry: bool = False
+    # adaptive-iteration eval_fn (module docstring "ADAPTIVE engines"):
+    # dispatches thread an iter_budget scalar through the eval_fn and
+    # Results carry per-item iters_used / final_delta
+    adaptive: bool = False
 
     def __post_init__(self):
         if self.batch_size < 1:
@@ -92,7 +107,8 @@ class ServeConfig:
     def from_args(cls, args, *, mode: str = "sintel",
                   warm_start: bool = False,
                   strict: Optional[bool] = None,
-                  device_carry: bool = False) -> "ServeConfig":
+                  device_carry: bool = False,
+                  adaptive: Optional[bool] = None) -> "ServeConfig":
         """Build from an argparse namespace that went through
         :func:`add_engine_args` — the ONE construction path eval_cli,
         serve_cli, and serve_bench share, so the batching knobs cannot
@@ -106,6 +122,8 @@ class ServeConfig:
             strict=(getattr(args, "strict", False)
                     if strict is None else strict),
             device_carry=device_carry,
+            adaptive=(getattr(args, "adaptive", False)
+                      if adaptive is None else adaptive),
         )
 
 
@@ -140,12 +158,19 @@ class Result(NamedTuple):
     flow_up is unpadded back to the item's own (H, W, 2); flow_low stays
     at the bucket's padded 1/8 resolution — it is the warm-start carry,
     and the next frame of the same sequence pads to the same bucket.
+
+    iters_used / final_delta are the adaptive path's per-item
+    convergence evidence (refinement updates actually applied; last
+    pre-freeze 1/8-res flow-delta norm); None on fixed-iteration
+    engines.
     """
 
     index: int
     item: Dict[str, Any]
     flow_low: np.ndarray
     flow_up: np.ndarray
+    iters_used: Optional[int] = None
+    final_delta: Optional[float] = None
 
 
 class _Ticket(NamedTuple):
@@ -153,6 +178,8 @@ class _Ticket(NamedTuple):
     flow_up: Any              # device array future (B, bh, bw, 2)
     entries: List[Tuple[int, Dict[str, Any], InputPadder]]
     t_dispatch: float
+    iters_used: Any = None    # adaptive: device (B,) int32 future
+    final_delta: Any = None   # adaptive: device (B,) float32 future
 
 
 class InferenceEngine:
@@ -264,8 +291,14 @@ class InferenceEngine:
 
     def _dispatch(self, bucket: Tuple[int, int],
                   group: List[Tuple[int, Dict[str, Any]]],
-                  mode: str) -> None:
+                  mode: str,
+                  iter_budget: Optional[int] = None) -> None:
         cfg = self.config
+        if iter_budget is not None and not cfg.adaptive:
+            raise ValueError(
+                "iter_budget passed to a fixed-iteration engine — build "
+                "it with ServeConfig(adaptive=True) and an adaptive "
+                "eval_fn (make_eval_step(adaptive=True))")
         t0 = time.perf_counter()
         padders = [InputPadder(it["image1"].shape, mode=mode,
                                stride=cfg.stride, target=bucket)
@@ -293,11 +326,21 @@ class InferenceEngine:
         # bucket step itself, and the per-row carry slices below.
         win = (self.watch.sanctioned() if fresh
                else contextlib.nullcontext())
+        iters_used = final_delta = None
         with win:
             fi = self._assemble_fi(bucket, inits) if will_fi else None
             im1, im2, fi = self.put((im1, im2, fi))
             t1 = time.perf_counter()
-            flow_low, flow_up = self.eval_fn(im1, im2, fi)
+            if cfg.adaptive:
+                # the ONE budget-normalization site (module docstring):
+                # every dispatch — warmup, scheduler-budgeted, default —
+                # presents the same int32 scalar aval, so the signature
+                # stays one executable per bucket
+                ib = None if iter_budget is None else np.int32(iter_budget)
+                flow_low, flow_up, iters_used, final_delta = \
+                    self.eval_fn(im1, im2, fi, ib)
+            else:
+                flow_low, flow_up = self.eval_fn(im1, im2, fi)
             if (fresh and cfg.device_carry
                     and not isinstance(flow_low, np.ndarray)):
                 # pre-compile the per-row carry slices: _fetch_one's
@@ -329,7 +372,8 @@ class InferenceEngine:
         self._inflight.append(_Ticket(
             flow_low, flow_up,
             [(idx, it, p) for (idx, it), p in zip(group, padders)],
-            t_dispatch=t0))
+            t_dispatch=t0, iters_used=iters_used,
+            final_delta=final_delta))
         self.stats.peak_inflight = max(self.stats.peak_inflight,
                                        len(self._inflight))
 
@@ -421,13 +465,31 @@ class InferenceEngine:
                     # plain Result plumbing, not carry bytes
                     self.stats.carry_d2h_bytes += low.nbytes
             up = jax.device_get(ticket.flow_up)
+        iu = fd = None
+        if ticket.iters_used is not None:
+            if isinstance(ticket.iters_used, np.ndarray):
+                # stub eval_fns hand host arrays straight through
+                iu, fd = ticket.iters_used, ticket.final_delta
+            else:
+                import jax  # deferred like the flow fetches above
+
+                # explicit D2H (jaxlint JL007): (B,) vectors, a few bytes
+                iu = jax.device_get(ticket.iters_used)
+                fd = jax.device_get(ticket.final_delta)
         now = time.perf_counter()
         self.stats.fetch_s += now - t0
         self.stats.fetches += 1
         self.stats.batch_latency_s.append(now - ticket.t_dispatch)
         for row, (idx, item, padder) in enumerate(ticket.entries):
             self.stats.frames += 1
-            yield Result(idx, item, low[row], padder.unpad(up[row]))
+            if iu is None:
+                yield Result(idx, item, low[row], padder.unpad(up[row]))
+            else:
+                self.stats.iters_used.append(int(iu[row]))
+                self.stats.final_delta.append(float(fd[row]))
+                yield Result(idx, item, low[row], padder.unpad(up[row]),
+                             iters_used=int(iu[row]),
+                             final_delta=float(fd[row]))
 
     def _drain_to(self, n: int) -> Iterator[Result]:
         while len(self._inflight) > n:
@@ -436,7 +498,8 @@ class InferenceEngine:
     # ---- public API ----------------------------------------------------
 
     def stream(self, items: Iterable[Dict[str, Any]],
-               mode: Optional[str] = None) -> Iterator[Result]:
+               mode: Optional[str] = None,
+               iter_budget: Optional[int] = None) -> Iterator[Result]:
         """Run every item through the engine; yield Results as their
         batches complete (bucket-grouped, NOT input order — each Result
         carries its original index).
@@ -444,6 +507,9 @@ class InferenceEngine:
         items: dicts with image1/image2 (H, W, C) and anything else the
         caller wants back on the Result (gt flow, extra_info, ...);
         an optional per-item flow_init rides the same dict.
+
+        iter_budget (adaptive engines only) caps every dispatched
+        batch's refinement iterations; None rides the full iters.
         """
         mode = mode or self.config.mode
         cfg = self.config
@@ -457,19 +523,26 @@ class InferenceEngine:
                 # fetch down to a free slot BEFORE dispatching, so at
                 # most `inflight` tickets are ever outstanding
                 yield from self._drain_to(cfg.inflight - 1)
-                self._dispatch(bucket, pending.pop(bucket), mode)
+                self._dispatch(bucket, pending.pop(bucket), mode,
+                               iter_budget=iter_budget)
         for bucket in sorted(pending):  # partial tails, deterministic order
             yield from self._drain_to(cfg.inflight - 1)
-            self._dispatch(bucket, pending.pop(bucket), mode)
+            self._dispatch(bucket, pending.pop(bucket), mode,
+                           iter_budget=iter_budget)
         yield from self._drain_to(0)
 
     def run_batch(self, items: List[Dict[str, Any]],
-                  mode: Optional[str] = None) -> List[Result]:
+                  mode: Optional[str] = None,
+                  iter_budget: Optional[int] = None) -> List[Result]:
         """Dispatch ONE batch synchronously and return Results in input
         order — the building block for sequenced workloads (Sintel
         warm-start carries the previous frame's flow_low, so frame j+1
         cannot dispatch before frame j fetches). All items must share a
         bucket; len(items) <= batch_size (the tail pad fills the rest).
+
+        iter_budget (adaptive engines only): this dispatch's iteration
+        budget — the scheduler's SLO/overload policy hands it in here;
+        None rides the step's full configured iters.
         """
         if not items:
             return []
@@ -490,7 +563,8 @@ class InferenceEngine:
                 f"run_batch with {len(self._inflight)} ticket(s) still in "
                 "flight from a previous stream(); consume that iterator "
                 "first (or use a separate engine)")
-        self._dispatch(buckets.pop(), list(enumerate(items)), mode)
+        self._dispatch(buckets.pop(), list(enumerate(items)), mode,
+                       iter_budget=iter_budget)
         out = sorted(self._fetch_one(), key=lambda r: r.index)
         return out
 
@@ -512,8 +586,13 @@ class InferenceEngine:
         self.compile_s = 0.0
 
     def stats_record(self) -> dict:
-        """Self-describing stats blob for bench records / logs."""
-        return {
+        """Self-describing stats blob for bench records / logs.
+
+        The adaptive keys appear ONLY on adaptive engines: fixed-path
+        records (and the serve_bench schemas pinned over them) are
+        byte-identical to before the adaptive path existed.
+        """
+        rec = {
             "batch_size": self.config.batch_size,
             "inflight": self.config.inflight,
             "frames": self.stats.frames,
@@ -530,3 +609,13 @@ class InferenceEngine:
             "latency_p99_ms": round(self.stats.latency_ms(99), 2),
             **self.registry.stats(),
         }
+        if self.config.adaptive:
+            rec.update(
+                adaptive=True,
+                iters_used_mean=round(self.stats.iters_used_mean(), 2),
+                iters_used_p50=round(self.stats.iters_used_pctl(50), 2),
+                iters_used_p99=round(self.stats.iters_used_pctl(99), 2),
+                final_delta_p50=round(self.stats.final_delta_pctl(50), 5),
+                final_delta_p99=round(self.stats.final_delta_pctl(99), 5),
+            )
+        return rec

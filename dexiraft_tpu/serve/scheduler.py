@@ -25,6 +25,18 @@ turns arrivals into engine batches under a latency contract:
     sheds load at admission instead of stretching everyone's latency
     (goodput stays flat instead of collapsing; serve_bench --closed_loop
     measures exactly this).
+  * adaptive iteration budgets (``adaptive=True``, engine built with
+    ServeConfig(adaptive=True)) — each dispatch carries an iteration
+    budget derived from the head request's REMAINING latency budget and
+    the queue's overload state: ``affordable = remaining_slo /
+    per_iter_est`` (a per-bucket EWMA of measured seconds-per-iteration)
+    capped by ``max_iters * (1 - queue_pressure)``, floored at
+    ``min_iters``. Under overload the service degrades refinement depth
+    smoothly (every admitted request still gets >= min_iters of real
+    work) BEFORE admission control starts shedding — a second, softer
+    valve ahead of the 503. Budgets are per-BATCH (the engine's
+    while_loop runs one budget per dispatch); convergence still exits
+    items early below the budget.
   * drain — ``drain()`` flips every queue to dispatch-immediately and
     blocks until empty: the SIGTERM path finishes every admitted request
     before the process exits, and new submits are refused.
@@ -95,12 +107,22 @@ class SchedulerStats:
             maxlen=_PCTL_WINDOW)
         self.latency_s: "collections.deque" = collections.deque(
             maxlen=_PCTL_WINDOW)
+        # adaptive mode only: the iteration budget each dispatched batch
+        # was granted (empty on fixed-iteration schedulers) — /stats
+        # reports p50/p99 so an operator can SEE the degradation valve
+        # working under load
+        self.iter_budget: "collections.deque" = collections.deque(
+            maxlen=_PCTL_WINDOW)
 
     @staticmethod
-    def _pctl_ms(samples, p: float) -> float:
+    def _pctl(samples, p: float) -> float:
         if not samples:
             return 0.0
-        return float(np.percentile(samples, p)) * 1e3
+        return float(np.percentile(samples, p))
+
+    @classmethod
+    def _pctl_ms(cls, samples, p: float) -> float:
+        return cls._pctl(samples, p) * 1e3
 
     def record(self) -> dict:
         batches = (self.dispatch_full + self.dispatch_slo
@@ -142,14 +164,34 @@ class Scheduler:
     def __init__(self, engine: InferenceEngine, *,
                  slo_ms: float = 200.0,
                  max_queue: int = 64,
+                 adaptive: bool = False,
+                 max_iters: int = 32,
+                 min_iters: int = 4,
                  clock: Callable[[], float] = time.monotonic):
         if slo_ms <= 0:
             raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if adaptive:
+            if not engine.config.adaptive:
+                raise ValueError(
+                    "Scheduler(adaptive=True) needs an adaptive engine — "
+                    "build it with ServeConfig(adaptive=True) and "
+                    "make_eval_step(adaptive=True)")
+            if not 1 <= min_iters <= max_iters:
+                raise ValueError(
+                    f"need 1 <= min_iters <= max_iters, got "
+                    f"min_iters={min_iters} max_iters={max_iters}")
         self.engine = engine
         self.slo_s = slo_ms / 1e3
         self.max_queue = max_queue
+        # adaptive budget policy knobs: max_iters mirrors the step's
+        # configured full iteration count (the budget is CLAMPED there
+        # again inside the while_loop, so a mismatch degrades safely);
+        # min_iters is the quality floor no overload can push below
+        self.adaptive = adaptive
+        self.max_iters = max_iters
+        self.min_iters = min_iters
         self.clock = clock
         self.stats = SchedulerStats()
         # called in the DISPATCHER thread after each successful batch,
@@ -172,6 +214,10 @@ class Scheduler:
         self._pending = 0
         self._dispatched = 0   # popped for a batch, result not yet set
         self._service_s: Dict[Tuple[int, int], float] = {}
+        # adaptive mode: per-bucket EWMA of measured seconds PER
+        # REFINEMENT ITERATION (batch service time / iterations the
+        # while_loop actually ran) — the unit the SLO budget divides by
+        self._iter_s: Dict[Tuple[int, int], float] = {}
         self._draining = False
         self._closed = False
         self._drained = threading.Event()
@@ -275,31 +321,60 @@ class Scheduler:
         self._dispatched += len(group)
         return group, len(group) == bs
 
+    def _iter_budget(self, bucket: Tuple[int, int], group: List["_Request"],
+                     now: float) -> Optional[int]:
+        """Under self._cv, after _take. SLO + overload state → this
+        dispatch's iteration budget (None on fixed schedulers).
+
+        Two pressures compound, both clamped to the [min_iters,
+        max_iters] band:
+          * affordable — the batch head's (oldest request's) remaining
+            SLO divided by the bucket's learned seconds-per-iteration.
+            Before the first measurement this is max_iters: early
+            batches run at full depth so the estimate learns the true
+            per-iteration cost, not a degraded one.
+          * pressure — queued/max_queue scales the cap linearly down
+            from max_iters toward the floor, so depth degrades SMOOTHLY
+            as the queue fills instead of binary full-depth-then-503.
+        """
+        if not self.adaptive:
+            return None
+        full = self.max_iters
+        remaining = max(0.0, self.slo_s - (now - group[0].t_submit))
+        per_iter = self._iter_s.get(bucket)
+        affordable = (full if per_iter is None or per_iter <= 0
+                      else remaining / per_iter)
+        pressure = min(1.0, self._pending / self.max_queue)
+        budget = int(min(affordable, full * (1.0 - pressure)))
+        return max(self.min_iters, min(full, budget))
+
     def poll_once(self) -> bool:
         """One dispatch decision + (if due) one engine batch. The unit
         tests' deterministic entry point; the dispatcher thread is this
         in a loop with cv waiting in between."""
         with self._cv:
-            bucket, _wait = self._select(self.clock())
+            now = self.clock()
+            bucket, _wait = self._select(now)
             if bucket is None:
                 return False
             group, full = self._take(bucket)
-        self._run(bucket, group, full)
+            budget = self._iter_budget(bucket, group, now)
+        self._run(bucket, group, full, budget)
         return True
 
     # ---- dispatch execution (dispatcher thread only) --------------------
 
     def _run(self, bucket: Tuple[int, int], group: List[_Request],
-             full: bool) -> None:
+             full: bool, budget: Optional[int] = None) -> None:
         try:
-            self._run_inner(bucket, group, full)
+            self._run_inner(bucket, group, full, budget)
         finally:
             with self._cv:
                 self._dispatched -= len(group)
                 self._cv.notify_all()   # inflight()==0 pollers re-check
 
     def _run_inner(self, bucket: Tuple[int, int], group: List[_Request],
-                   full: bool) -> None:
+                   full: bool, budget: Optional[int] = None) -> None:
         st = self.stats
         t0 = self.clock()
         # counter bumps take the cv: handler threads mutate the same
@@ -318,9 +393,12 @@ class Scheduler:
             st.batch_fill += len(group)
             for r in group:
                 st.wait_s.append(t0 - r.t_submit)
+            if budget is not None:
+                st.iter_budget.append(budget)
         compile0 = self.engine.compile_s
         try:
-            results = self.engine.run_batch([r.item for r in group])
+            results = self.engine.run_batch([r.item for r in group],
+                                            iter_budget=budget)
         except Exception as e:
             with self._cv:
                 st.failed += len(group)
@@ -338,6 +416,18 @@ class Scheduler:
             prev = self._service_s.get(bucket)
             self._service_s[bucket] = (dt if prev is None
                                        else (1 - _EWMA) * prev + _EWMA * dt)
+            if budget is not None:
+                # the while_loop ran max(iters_used) steps, not the full
+                # budget — divide by what EXECUTED so early-converging
+                # batches don't inflate the per-iteration estimate
+                ran = max((r.iters_used for r in results
+                           if r.iters_used is not None), default=budget)
+                if ran and ran > 0:
+                    per = dt / ran
+                    prevp = self._iter_s.get(bucket)
+                    self._iter_s[bucket] = (
+                        per if prevp is None
+                        else (1 - _EWMA) * prevp + _EWMA * per)
         if self.post_dispatch is not None:
             # BEFORE the events fire: a waiter acting on its result
             # (e.g. the server's carry splat) must find whatever this
@@ -367,9 +457,11 @@ class Scheduler:
                     # work while still holding the lock and starve them
                     self._cv.wait(timeout=0.05)
                 while True:
-                    bucket, wait = self._select(self.clock())
+                    now = self.clock()
+                    bucket, wait = self._select(now)
                     if bucket is not None:
                         group, full = self._take(bucket)
+                        budget = self._iter_budget(bucket, group, now)
                         self._running = True
                         break
                     if self._pending == 0:
@@ -379,7 +471,7 @@ class Scheduler:
                         if self._draining:
                             self._drained.set()
                     self._cv.wait(timeout=wait)
-            self._run(bucket, group, full)
+            self._run(bucket, group, full, budget)
 
     def run_quiesced(self, fn: Callable[[], None]) -> None:
         """Run `fn` while the dispatcher provably is NOT inside the
@@ -444,7 +536,11 @@ class Scheduler:
             # (submit paths and the dispatcher's bumps): no torn
             # completed-vs-latency combinations in a scrape
             counters = self.stats.record()
-        return {
+            budget_p50 = SchedulerStats._pctl(self.stats.iter_budget, 50)
+            budget_p99 = SchedulerStats._pctl(self.stats.iter_budget, 99)
+            iter_ests = {f"{h}x{w}": round(s * 1e3, 3)
+                         for (h, w), s in sorted(self._iter_s.items())}
+        rec = {
             **counters,
             "queue_depth": depth,
             "inflight": inflight,
@@ -453,3 +549,15 @@ class Scheduler:
             "service_est_ms": ests,
             "draining": self.draining,
         }
+        if self.adaptive:
+            # adaptive keys only on adaptive schedulers: fixed-path
+            # /stats and bench schema pins stay byte-identical
+            rec.update(
+                adaptive=True,
+                min_iters=self.min_iters,
+                max_iters=self.max_iters,
+                iter_budget_p50=round(budget_p50, 2),
+                iter_budget_p99=round(budget_p99, 2),
+                iter_est_ms=iter_ests,
+            )
+        return rec

@@ -334,10 +334,18 @@ class _Handler(BaseHTTPRequestHandler):
             # compile, which is what keeps --strict serving race-free.
             svc.sessions.put(session_id, bucket,
                              svc.carry_fn(result.flow_low))
+        headers = {"X-Warm-Start": "1" if warm else "0",
+                   "X-Bucket": f"{bucket[0]}x{bucket[1]}"}
+        if result.iters_used is not None:
+            # adaptive engines only: how many refinement iterations THIS
+            # item actually ran before its convergence gate (or the
+            # scheduler's SLO budget) stopped it, and the last pre-stop
+            # flow-delta norm — per-request convergence evidence on the
+            # wire, no extra body bytes
+            headers["X-Iters-Used"] = str(result.iters_used)
+            headers["X-Final-Delta"] = f"{result.final_delta:.6f}"
         self._send(200, encode_response(result.flow_up),
-                   "application/x-npz",
-                   {"X-Warm-Start": "1" if warm else "0",
-                    "X-Bucket": f"{bucket[0]}x{bucket[1]}"})
+                   "application/x-npz", headers)
 
     def _post_stream(self, svc: "FlowService", body: bytes) -> None:
         """POST /v1/flow/stream: one chunk of a video stream through the
@@ -376,12 +384,16 @@ class _Handler(BaseHTTPRequestHandler):
                 500, f"streaming inference failed: "
                      f"{type(e).__name__}: {e}")
             return
+        headers = {"X-Warm-Start": "1" if res.warm else "0",
+                   "X-Bucket": f"{res.bucket[0]}x{res.bucket[1]}",
+                   "X-Frames-In": str(res.frames_in),
+                   "X-Flows-Out": str(len(res.flows))}
+        if getattr(res, "iters_used", None) is not None:
+            # adaptive streaming: mean refinement iterations across this
+            # chunk's frame pairs (per-pair detail is in /stats)
+            headers["X-Iters-Used"] = f"{res.iters_used:.1f}"
         self._send(200, encode_stream_response(res.flows),
-                   "application/x-npz",
-                   {"X-Warm-Start": "1" if res.warm else "0",
-                    "X-Bucket": f"{res.bucket[0]}x{res.bucket[1]}",
-                    "X-Frames-In": str(res.frames_in),
-                    "X-Flows-Out": str(len(res.flows))})
+                   "application/x-npz", headers)
 
 
 # ---- the service object -------------------------------------------------
@@ -405,6 +417,9 @@ class FlowService:
         port: int = 0,
         slo_ms: float = 200.0,
         max_queue: int = 64,
+        adaptive: Optional[bool] = None,
+        max_iters: int = 32,
+        min_iters: int = 4,
         session_ttl_s: float = 60.0,
         max_sessions: int = 1024,
         carry_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
@@ -423,8 +438,16 @@ class FlowService:
         # /v1/flow/stream answering 404 with a how-to-enable message
         self.video = video
         self.clock = clock
+        # adaptive defaults to the engine's mode: an adaptive engine
+        # behind the service gets SLO-driven iteration budgets unless
+        # the caller explicitly opts the scheduler out (adaptive=False
+        # keeps budgets at the full iters; convergence exits still fire)
+        if adaptive is None:
+            adaptive = engine.config.adaptive
         self.scheduler = Scheduler(engine, slo_ms=slo_ms,
-                                   max_queue=max_queue, clock=clock)
+                                   max_queue=max_queue, adaptive=adaptive,
+                                   max_iters=max_iters, min_iters=min_iters,
+                                   clock=clock)
         # session_ttl_s <= 0 = stateless mode (multi-worker default:
         # kernel accept-balancing breaks per-worker affinity anyway)
         self.sessions = (SessionStore(session_ttl_s, max_sessions,
@@ -490,6 +513,10 @@ class FlowService:
                 "draining": self.draining,
                 "slo_ms": round(self.scheduler.slo_s * 1e3, 2),
                 "sessions_enabled": self.sessions is not None,
+                # the engine/scheduler blocks carry the adaptive detail
+                # (iters_used percentiles, budget policy state); this
+                # flag is the one-glance "is this replica adaptive"
+                "adaptive": self.engine.config.adaptive,
             },
             "engine": self.engine.stats_record(),
             "scheduler": self.scheduler.stats_record(),

@@ -86,6 +86,10 @@ class ChunkResult(NamedTuple):
     warm: bool                  # the session carry seeded the first pair
     bucket: Tuple[int, int]
     frames_in: int
+    # adaptive engines only (None on fixed refine fns): mean refinement
+    # iterations actually run across this chunk's pairs — the stream
+    # twin of the pair endpoint's X-Iters-Used header
+    iters_used: Optional[float] = None
 
 
 class VideoEngine:
@@ -108,6 +112,7 @@ class VideoEngine:
         bucket_multiple: Optional[int] = None,
         max_chunk_frames: int = 64,
         max_pending_chunks: int = 8,
+        adaptive: bool = False,
         strict: bool = False,
         watch=None,
     ):
@@ -132,6 +137,11 @@ class VideoEngine:
         self.bucket_multiple = bucket_multiple
         self.max_chunk_frames = max_chunk_frames
         self.max_pending_chunks = max_pending_chunks
+        # adaptive contract: refine_fn returns (flow_low, flow_up,
+        # iters_used, final_delta) — the convergence gate exits early
+        # per-pair; streaming rides the FULL iteration budget (chunks
+        # bypass the scheduler's SLO budgets; the gate is the win here)
+        self.adaptive = adaptive
         self.strict = strict
         if watch is None:
             from dexiraft_tpu.analysis.guards import RecompileWatch
@@ -160,6 +170,12 @@ class VideoEngine:
         self.warm_chunks = 0
         self.cold_chunks = 0
         self.flow_latency_s: "collections.deque" = collections.deque(
+            maxlen=_PCTL_WINDOW)
+        # adaptive mode: per-pair iters_used / final-delta samples
+        # (empty deques on fixed engines — /stats keys are conditional)
+        self.iters_used: "collections.deque" = collections.deque(
+            maxlen=_PCTL_WINDOW)
+        self.final_delta: "collections.deque" = collections.deque(
             maxlen=_PCTL_WINDOW)
 
     # ---- input validation ----------------------------------------------
@@ -257,6 +273,7 @@ class VideoEngine:
                     warm = True
 
             flows: List[np.ndarray] = []
+            chunk_iters: List[int] = []
             # a fresh bucket's frame loop compiles encode/refine/splat:
             # run it inside a sanctioned window so the pair dispatcher's
             # concurrent strict check (shared watch, process-global
@@ -273,13 +290,27 @@ class VideoEngine:
                     if feats_prev is not None:
                         if flow_init is None:
                             flow_init = self._zero_flow_init(h8, w8)
-                        flow_low, flow_up = self.refine_fn(
-                            feats_prev, feats, flow_init)
+                        if self.adaptive:
+                            (flow_low, flow_up, pair_iters,
+                             pair_delta) = self.refine_fn(
+                                feats_prev, feats, flow_init)
+                            # one fetch per pair, same sanctioned D2H as
+                            # flow_up (the (1,) scalars piggyback on the
+                            # payload fetch, not a new transfer class)
+                            iu = int(_to_host(pair_iters)[0])
+                            fd = float(_to_host(pair_delta)[0])
+                        else:
+                            flow_low, flow_up = self.refine_fn(
+                                feats_prev, feats, flow_init)
                         flow_init = self.splat_fn(flow_low)
                         flows.append(padder.unpad(_to_host(flow_up)[0]))
                         with self._stats_lock:
                             self.flow_latency_s.append(
                                 time.perf_counter() - t0)
+                            if self.adaptive:
+                                chunk_iters.append(iu)
+                                self.iters_used.append(iu)
+                                self.final_delta.append(fd)
                     feats_prev = feats
 
             if session_id is not None and self.sessions is not None:
@@ -307,7 +338,9 @@ class VideoEngine:
                 self.watch.check()
             else:
                 self.watch.warn_if_drifted()
-        return ChunkResult(flows, warm, bucket, t_frames)
+        mean_iters = (sum(chunk_iters) / len(chunk_iters)
+                      if chunk_iters else None)
+        return ChunkResult(flows, warm, bucket, t_frames, mean_iters)
 
     # ---- lifecycle / observability -------------------------------------
 
@@ -338,6 +371,8 @@ class VideoEngine:
             self.chunks = self.frames_in = self.flows_out = 0
             self.warm_chunks = self.cold_chunks = 0
             self.flow_latency_s.clear()
+            self.iters_used.clear()
+            self.final_delta.clear()
         if self.sessions is not None:
             self.sessions.reset_counters()
 
@@ -363,6 +398,20 @@ class VideoEngine:
                 "compiled_buckets": sorted(
                     f"{h}x{w}" for h, w in self._compiled),
             }
+            if self.adaptive:
+                # conditional like the engine's block: fixed-path /stats
+                # schema pins stay byte-identical
+                iu = list(self.iters_used)
+                rec.update(
+                    adaptive=True,
+                    iters_used_mean=(round(sum(iu) / len(iu), 2)
+                                     if iu else 0.0),
+                    iters_used_p99=(round(float(
+                        np.percentile(iu, 99)), 2) if iu else 0.0),
+                    final_delta_p50=(round(float(np.percentile(
+                        list(self.final_delta), 50)), 5)
+                        if self.final_delta else 0.0),
+                )
         rec["sessions"] = (self.sessions.stats_record()
                           if self.sessions is not None else None)
         return rec

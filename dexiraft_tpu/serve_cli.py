@@ -68,7 +68,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dexined_upconv", default="subpixel",
                    choices=["transpose", "subpixel"])
     p.add_argument("--iters", type=int, default=24,
-                   help="refinement iterations per request")
+                   help="refinement iterations per request (the budget "
+                        "CAP with --adaptive)")
+    p.add_argument("--adaptive", action="store_true",
+                   help="adaptive-iteration inference: the refinement "
+                        "while_loop freezes each item at convergence "
+                        "(converge_tol) and the scheduler turns each "
+                        "batch head's remaining SLO + queue pressure "
+                        "into a per-dispatch iteration budget — "
+                        "overload degrades refinement depth smoothly "
+                        "before admission control sheds "
+                        "(docs/serving.md \"Adaptive iterations\")")
+    p.add_argument("--converge_tol", type=float, default=None,
+                   help="override RAFTConfig.converge_tol (mean 1/8-res "
+                        "flow-delta norm below which an item stops "
+                        "refining; 0 disables the gate)")
+    p.add_argument("--min_iters", type=int, default=4,
+                   help="adaptive budget floor: no SLO/overload "
+                        "pressure pushes a dispatch below this many "
+                        "refinement iterations")
     p.add_argument("--mode", default="sintel", choices=["sintel", "kitti"],
                    help="pad placement for bucket padding")
     # engine knobs — the ONE shared surface with eval_cli/serve_bench
@@ -209,6 +227,11 @@ def _load(args):
                                  fused_update=fused,
                                  dexined_upconv=args.dexined_upconv,
                                  scan_unroll=args.scan_unroll)
+    if getattr(args, "converge_tol", None) is not None:
+        import dataclasses
+
+        # checkpoint-compatible: the gate threshold shapes no params
+        cfg = dataclasses.replace(cfg, converge_tol=args.converge_tol)
     if args.synthetic_init:
         state = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
         print("[serve] synthetic init: serving RANDOM weights "
@@ -270,6 +293,20 @@ def _warmup(engine, geometries, carry_fn=None, video=None) -> None:
         if carry_fn is not None:
             carry_fn(res.flow_low)
             engine.watch.mark_warm()  # expected compile, not drift
+        if engine.config.adaptive:
+            # the budget is a TRACED int32 scalar: a second dispatch at
+            # a different explicit budget (plus the iters_used/delta
+            # fetch it exercises) must ride the executable the first
+            # dispatch compiled. check() turns any accidental budget
+            # re-specialization into a boot-time error instead of a
+            # first-request 500 under --strict.
+            (res2,) = engine.run_batch([item], iter_budget=1)
+            engine.watch.check()
+            if res2.iters_used is None:
+                raise RuntimeError(
+                    "adaptive engine returned no iters_used during "
+                    "warmup — eval_fn is not the adaptive 4-tuple "
+                    "contract (make_eval_step(adaptive=True))")
     if video is not None:
         video.warmup(geometries)
     engine.reset_stats()  # warmup is not traffic
@@ -305,8 +342,21 @@ def _make_video_engine(args, cfg, variables, mesh, sessions_on,
     from dexiraft_tpu.serve.video import VideoEngine
     from dexiraft_tpu.train.step import make_encode_step, make_refine_step
 
+    import numpy as np
+
     encode_step = make_encode_step(cfg)
-    refine_step = make_refine_step(cfg, iters=args.iters)
+    adaptive = getattr(args, "adaptive", False)
+    refine_step = make_refine_step(cfg, iters=args.iters,
+                                   adaptive=adaptive)
+    if adaptive:
+        # streaming rides the FULL budget (chunks bypass the
+        # scheduler's SLO policy); the convergence gate still exits
+        # early per pair. One np.int32 aval = one executable per bucket.
+        full = np.int32(args.iters)
+        refine_fn = (lambda f1, f2, fi:
+                     refine_step(variables, f1, f2, fi, full))
+    else:
+        refine_fn = lambda f1, f2, fi: refine_step(variables, f1, f2, fi)
     # the splat stays on device: flow_low (1, h/8, w/8, 2) -> the next
     # pair's seed, one jitted executable per bucket shape (warmup
     # absorbs the compile)
@@ -317,13 +367,14 @@ def _make_video_engine(args, cfg, variables, mesh, sessions_on,
         max_sessions=1024)
     return VideoEngine(
         lambda frame: encode_step(variables, frame),
-        lambda f1, f2, fi: refine_step(variables, f1, f2, fi),
+        refine_fn,
         splat,
         sessions=store,
         put=jax.device_put,
         mode=args.mode,
         bucket_multiple=args.bucket_multiple,
         max_chunk_frames=args.stream_chunk_frames,
+        adaptive=adaptive,
         strict=args.strict,
         # ONE RecompileWatch with the pair engine: the backend compile
         # counter is process-global, so a separate watch would let a
@@ -388,6 +439,9 @@ def _serve_one(args) -> None:
         engine,
         host=args.host, port=args.port,
         slo_ms=args.slo_ms, max_queue=args.max_queue,
+        # adaptive defaults from engine.config; the scheduler clamps
+        # every SLO/overload budget to [min_iters, iters]
+        max_iters=args.iters, min_iters=args.min_iters,
         session_ttl_s=args.session_ttl_s,
         carry_fn=carry_fn,
         request_timeout_s=args.request_timeout_s,
@@ -400,7 +454,10 @@ def _serve_one(args) -> None:
     print(f"[serve] listening on {service.url}{tag} — "
           f"batch_size={args.batch_size} slo_ms={args.slo_ms:g} "
           f"sessions={'on' if sessions_on else 'off'} "
-          f"strict={'on' if args.strict else 'off'}", flush=True)
+          f"strict={'on' if args.strict else 'off'}"
+          + (f" adaptive=on (tol={cfg.converge_tol:g}, "
+             f"iters {args.min_iters}..{args.iters})"
+             if args.adaptive else ""), flush=True)
 
     try:
         while not service.stopped.wait(1.0):

@@ -54,6 +54,7 @@ from dexiraft_tpu.parallel import halo
 from dexiraft_tpu.parallel.layout import (
     LAYOUT,
     batch_input_sharding,
+    batch_sharding,
     replicated_sharding,
     state_sharding,
     variables_sharding,
@@ -390,7 +391,8 @@ def make_eval_step(
     iters: int = 24,
     mesh: Optional[Mesh] = None,
     compute_sharding: str = "fence",
-) -> Callable[..., Tuple[jax.Array, jax.Array]]:
+    adaptive: bool = False,
+) -> Callable[..., Tuple[jax.Array, ...]]:
     """Jitted test-mode forward: (flow_low, flow_up) like core/raft.py:194-197.
 
     Batched NHWC inputs throughout — the serving engine
@@ -416,12 +418,25 @@ def make_eval_step(
     image1, image2, flow_init), positional, no edge arguments (v1
     only) and flow_init always materialized (zeros = cold start) —
     because its in_shardings pin the halo contract, not the engine's.
+
+    ``adaptive=True`` swaps the fixed scan for the convergence-gated
+    while_loop (RAFT adaptive=True): the step grows a trailing
+    ``iter_budget`` positional — a TRACED int32 scalar, so ONE compiled
+    executable per bucket serves every budget — and returns
+    (flow_low, flow_up, iters_used[B], final_delta[B]). The (B,)
+    outputs pin batch-only shardings on a mesh (they have no spatial
+    dims for a seq axis to split).
     """
     if compute_sharding not in ("fence", "halo"):
         raise ValueError(f"compute_sharding must be fence|halo, "
                          f"got {compute_sharding!r}")
     model = RAFT(cfg)
     if compute_sharding == "halo":
+        if adaptive:
+            raise ValueError(
+                "adaptive=True is not supported with "
+                "compute_sharding='halo' (the shard_map'd row-slab "
+                "forward drives the fixed-iteration halo loop)")
         return _make_halo_eval_step(cfg, iters, mesh, model)
 
     def step(
@@ -431,10 +446,13 @@ def make_eval_step(
         edges1: Optional[jax.Array] = None,
         edges2: Optional[jax.Array] = None,
         flow_init: Optional[jax.Array] = None,
+        iter_budget: Optional[jax.Array] = None,
     ):
         kwargs: Dict[str, Any] = {}
         if edges1 is not None:
             kwargs = dict(edges1=edges1, edges2=edges2)
+        if adaptive:
+            kwargs.update(adaptive=True, iter_budget=iter_budget)
         return model.apply(
             variables,
             image1,
@@ -450,8 +468,16 @@ def make_eval_step(
         return jax.jit(step)
     repl = replicated_sharding(mesh)
     data = batch_input_sharding(mesh)
+    vec = batch_sharding(mesh)  # (B,) outputs: batch axis only
     # one `data` leaf per batched positional (images, edges, flow_init);
-    # a None optional consumes its sharding entry as an empty pytree
+    # a None optional consumes its sharding entry as an empty pytree.
+    # The adaptive budget scalar replicates like every other scalar.
+    if adaptive:
+        return jax.jit(
+            step,
+            in_shardings=(repl, data, data, data, data, data, repl),
+            out_shardings=(data, data, vec, vec),
+        )
     return jax.jit(
         step,
         in_shardings=(repl, data, data, data, data, data),
@@ -550,7 +576,8 @@ def make_refine_step(
     cfg: RAFTConfig,
     iters: int = 24,
     mesh: Optional[Mesh] = None,
-) -> Callable[..., Tuple[jax.Array, jax.Array]]:
+    adaptive: bool = False,
+) -> Callable[..., Tuple[jax.Array, ...]]:
     """Jitted refinement stage (RAFT mode="step"), test-mode returns.
 
     (variables, features1, features2, flow_init) -> (flow_low, flow_up)
@@ -558,6 +585,10 @@ def make_refine_step(
     and flow_init is always materialized (a zeros flow_init equals no
     warm start — the engine's one-executable-per-bucket contract).
     Same param tree as the monolithic step; checkpoints interchange.
+
+    ``adaptive=True``: same contract extension as make_eval_step — a
+    trailing traced ``iter_budget`` scalar and (flow_low, flow_up,
+    iters_used[B], final_delta[B]) returns.
     """
     model = RAFT(cfg)
 
@@ -566,15 +597,25 @@ def make_refine_step(
         features1: Dict[str, jax.Array],
         features2: Dict[str, jax.Array],
         flow_init: Optional[jax.Array] = None,
-    ) -> Tuple[jax.Array, jax.Array]:
+        iter_budget: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, ...]:
+        kwargs: Dict[str, Any] = {}
+        if adaptive:
+            kwargs.update(adaptive=True, iter_budget=iter_budget)
         return model.apply(variables, None, iters=iters,
                            flow_init=flow_init, train=False,
                            test_mode=True, mode="step",
-                           features1=features1, features2=features2)
+                           features1=features1, features2=features2,
+                           **kwargs)
 
     if mesh is None:
         return jax.jit(refine)
     repl = replicated_sharding(mesh)
     data = batch_input_sharding(mesh)
+    if adaptive:
+        vec = batch_sharding(mesh)
+        return jax.jit(refine,
+                       in_shardings=(repl, data, data, data, repl),
+                       out_shardings=(data, data, vec, vec))
     return jax.jit(refine, in_shardings=(repl, data, data, data),
                    out_shardings=(data, data))

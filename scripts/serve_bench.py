@@ -42,6 +42,18 @@ replica PROCESSES and drives the router (serve/router.py) over them:
 The bench process itself never imports jax: replicas own the devices.
 Record schema pinned by FLEET_RECORD_KEYS / tests/test_zzfleet_router.
 
+**Adaptive mode** (``--adaptive``): the convergence-gated early-exit
+engine (ServeConfig(adaptive=True) over make_eval_step(adaptive=True))
+against the fixed-iteration engine with the SAME weights — the
+synthetic-init contraction fixture (FlowHead_0 damped x0.01, see
+docs/perf.md). Three phases: (1) quality/iters — per-pair EPE between
+adaptive and fixed flows plus iters_used stats (the early-exit win must
+not move the answer), (2) latency — per-pair wall time both legs,
+(3) overload — OPEN arrivals at the same offered rate against BOTH
+services: the adaptive scheduler must degrade iteration budgets
+(iter_budget_p50 < max_iters) while goodput holds (ratio ~>= 1).
+Record schema pinned by ADAPTIVE_RECORD_KEYS / tests/test_zzzadaptive.
+
 Watchdog (the bench.py pattern, tests/test_bench_watchdog.py /
 tests/test_zserve_bench.py): the measurement runs in a CHILD process;
 the parent kills it when it goes silent past SERVE_BENCH_STALL_S or
@@ -58,6 +70,8 @@ Usage: python scripts/serve_bench.py [--variant v1] [--small]
            [--overload_factor 4] [--warm_frames 4] [--cpu]
        python scripts/serve_bench.py --fleet 2 [--size 64x96]
            [--requests 48] [--concurrency 4] [--iters 2] [--cpu]
+       python scripts/serve_bench.py --adaptive [--size 96x128]
+           [--iters 32] [--min_iters 4] [--converge_tol 0.02] [--cpu]
 """
 
 from __future__ import annotations
@@ -127,6 +141,24 @@ WARM_KEYS = {
     "warm_beats_cold",
 }
 
+# ---- adaptive-iteration record schema, pinned by
+# tests/test_zzzadaptive.py -----------------------------------------------
+ADAPTIVE_RECORD_KEYS = {
+    "metric", "platform", "variant", "iters", "size", "frames", "batch",
+    "slo_ms", "max_queue", "converge_tol", "min_iters",
+    "corr_impl_resolved",
+    "epe_vs_fixed_px", "mean_iters_used", "p99_iters_used",
+    "iters_drop_pct", "mean_final_delta",
+    "fixed_ms_per_pair", "adaptive_ms_per_pair",
+    "overload_fixed", "overload_adaptive", "overload_goodput_ratio",
+}
+# the adaptive overload entry carries the fixed OVERLOAD_KEYS plus the
+# degradation evidence: what budgets the scheduler actually granted and
+# how many iterations the while_loop actually ran
+ADAPTIVE_OVERLOAD_KEYS = OVERLOAD_KEYS | {
+    "iter_budget_p50", "iter_budget_p99", "iters_used_mean",
+}
+
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
@@ -185,6 +217,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--warm_frames", type=int, default=4,
                     help="frames chained through one session for the "
                          "warm-start convergence check")
+    # ---- adaptive-iteration mode ---------------------------------------
+    ap.add_argument("--adaptive", action="store_true",
+                    help="adaptive-iteration leg: convergence-gated "
+                         "early-exit engine vs the fixed-iters engine on "
+                         "the damped contraction fixture — EPE delta, "
+                         "iters_used, latency, overload goodput with "
+                         "degraded budgets")
+    ap.add_argument("--converge_tol", type=float, default=None,
+                    help="override RAFTConfig.converge_tol for the "
+                         "adaptive leg (default: the config's)")
+    ap.add_argument("--min_iters", type=int, default=4,
+                    help="adaptive scheduler budget floor (clamped to "
+                         "--iters)")
     # ---- fleet (router) mode -------------------------------------------
     ap.add_argument("--fleet", type=int, default=0,
                     help="spawn this many --synthetic_init serve replica "
@@ -197,10 +242,23 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def _build_eval_fn(args, iters=None):
+def _build_eval_fn(args, iters=None, adaptive=False, damp_flow_head=None):
     """Model + jitted eval step + engine-contract eval_fn — shared by
     the engine-mode measurement and the closed-loop service phases.
-    Returns (eval_fn, mesh, step, variables)."""
+    Returns (eval_fn, mesh, step, variables).
+
+    adaptive=True builds the convergence-gated while_loop step
+    (make_eval_step(adaptive=True)); the eval_fn then takes a trailing
+    iter_budget (None -> the full configured iters, normalized to ONE
+    np.int32 aval so every budget rides the bucket's single executable)
+    and returns the 4-tuple (flow_low, flow_up, iters_used, final_delta).
+
+    damp_flow_head scales every FlowHead_0 param leaf (the contraction
+    fixture, docs/perf.md: random-init updates do not contract, damping
+    the flow head's output gives the convergence plateau a trained model
+    has — the adaptive leg needs weights that actually converge).
+    Identical PRNGKey(0) init means two calls hand back identical
+    weights, so a fixed/adaptive A/B shares one set of parameters."""
     import jax
 
     from dexiraft_tpu import config as C
@@ -222,8 +280,24 @@ def _build_eval_fn(args, iters=None):
     cfg = getattr(C, f"raft_{args.variant}")(small=args.small,
                                              corr_impl=impl,
                                              fused_update=fused)
+    if getattr(args, "converge_tol", None) is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, converge_tol=args.converge_tol)
+    args.converge_tol_resolved = cfg.converge_tol
     state = create_state(jax.random.PRNGKey(0), cfg, TrainConfig())
     variables = {"params": state.params, "batch_stats": state.batch_stats}
+    if damp_flow_head:
+        from jax.tree_util import tree_map_with_path
+
+        def _damp(path, leaf):
+            keys = [getattr(p, "key", getattr(p, "name", None))
+                    for p in path]
+            return leaf * damp_flow_head if "FlowHead_0" in keys else leaf
+
+        variables = {"params": tree_map_with_path(_damp,
+                                                  variables["params"]),
+                     "batch_stats": variables["batch_stats"]}
 
     mesh = None
     if args.data_parallel > 0:
@@ -233,8 +307,26 @@ def _build_eval_fn(args, iters=None):
         # params must live replicated on the mesh up front, or the
         # pinned replicated in_sharding re-transfers them every dispatch
         variables = replicate(variables, mesh)
-    step = make_eval_step(cfg, iters=iters or args.iters, mesh=mesh)
-    if mesh is None:
+    full = iters or args.iters
+    step = make_eval_step(cfg, iters=full, mesh=mesh, adaptive=adaptive)
+    if adaptive:
+        import numpy as np
+
+        # the trailing iter_budget arrives from the engine already
+        # np.int32-normalized (or None = ride the full iters) — resolve
+        # None to the SAME int32 aval so warmup and budgeted dispatches
+        # share one executable per bucket
+        if mesh is None:
+            put = jax.device_put
+            eval_fn = lambda a, b, fi, ib=None: step(
+                variables, put(a), put(b),
+                flow_init=None if fi is None else put(fi),
+                iter_budget=np.int32(full if ib is None else ib))
+        else:
+            eval_fn = lambda a, b, fi, ib=None: step(
+                variables, a, b, None, None, fi,
+                np.int32(full if ib is None else ib))
+    elif mesh is None:
         # explicit H2D puts: the engine hands host-stacked numpy
         # batches; spelling the transfer keeps the strict regions
         # (guards.strict_mode) clean without widening their teeth
@@ -559,7 +651,7 @@ def _overload_sender(host: str, port: int, body: bytes, interval: float,
 
 
 def _run_overload(service, body: bytes, offered_rps: float,
-                  duration_s: float) -> dict:
+                  duration_s: float, stats_out: dict = None) -> dict:
     """OPEN arrivals at a fixed offered rate (no back-pressure from
     completions): admission control must shed the excess with 503s and
     keep goodput near capacity — the queue-collapse counterexample.
@@ -594,7 +686,11 @@ def _run_overload(service, body: bytes, offered_rps: float,
         "goodput_rps": round(len(latencies) / wall, 3) if wall else 0.0,
         "p99_ms": _pctl_ms(latencies, 99),
     }
-    _http_get_json(host, port, "/stats?reset=1")
+    payload = _http_get_json(host, port, "/stats?reset=1")
+    if stats_out is not None:
+        # the adaptive leg reads the scheduler's granted-budget stats
+        # out of the same scrape-and-reset the window handoff uses
+        stats_out.update(payload)
     print(f"[overload] offered {out['offered_rps']} req/s for "
           f"{duration_s:g}s: {out['completed']} served, "
           f"{out['rejected']} shed / {out['errors']} errored, "
@@ -729,6 +825,155 @@ def _measure_closed_loop(args) -> None:
     assert all(set(lv) == LEVEL_KEYS for lv in levels)
     assert set(overload) == OVERLOAD_KEYS
     assert set(warm_start) == WARM_KEYS
+    print(json.dumps(record), flush=True)
+
+
+# ---- adaptive-iteration mode --------------------------------------------
+
+
+def _measure_adaptive(args) -> None:
+    """Adaptive-iteration leg (docstring "Adaptive mode"): the
+    convergence-gated engine vs the fixed-iteration engine, SAME damped
+    weights. Emits ONE JSON record (ADAPTIVE_RECORD_KEYS)."""
+    import threading  # noqa: F401  (client threads under the hood)
+
+    import jax
+    import numpy as np
+
+    from dexiraft_tpu.data.padder import InputPadder
+    from dexiraft_tpu.serve import InferenceEngine, ServeConfig, bucket_shape
+    from dexiraft_tpu.serve.server import FlowService, encode_request
+
+    h, w = (int(v) for v in args.size.split("x"))
+    rng = np.random.default_rng(0)
+    body = encode_request(
+        rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+        rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+    min_iters = max(1, min(args.min_iters, args.iters))
+
+    # identical PRNGKey(0) init + identical damping -> the two steps
+    # share one set of weights; only the refinement driver differs
+    fixed_fn, mesh, _, _ = _build_eval_fn(args, damp_flow_head=0.01)
+    adapt_fn, _, _, _ = _build_eval_fn(args, adaptive=True,
+                                       damp_flow_head=0.01)
+    tol = args.converge_tol_resolved
+    print(f"platform={jax.devices()[0].platform} variant={args.variant} "
+          f"small={args.small} iters={args.iters} size={args.size} "
+          f"converge_tol={tol:g} min_iters={min_iters}", file=sys.stderr)
+
+    # -- phase 1+2: per-pair quality / iters_used / latency ---------------
+    bucket = bucket_shape(h, w, multiple=args.bucket_multiple)
+    padder = InputPadder((h, w, 3), mode="sintel", target=bucket)
+    pairs = [(rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+              rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+             for _ in range(args.frames)]
+
+    def prep(im):
+        return jax.device_put(padder.pad(im)[0][None])
+
+    # warmup both signatures outside the timed loop (one compile each)
+    a0, b0 = prep(pairs[0][0]), prep(pairs[0][1])
+    jax.block_until_ready(fixed_fn(a0, b0, None))
+    jax.block_until_ready(adapt_fn(a0, b0, None))
+
+    epes, used, deltas = [], [], []
+    t_fixed = t_adapt = 0.0
+    for im1, im2 in pairs:
+        a, b = prep(im1), prep(im2)
+        t0 = time.perf_counter()  # jaxlint: disable=JL004
+        _, up_f = jax.block_until_ready(fixed_fn(a, b, None))
+        t_fixed += time.perf_counter() - t0  # jaxlint: disable=JL004
+        t0 = time.perf_counter()  # jaxlint: disable=JL004
+        _, up_a, iu, fd = jax.block_until_ready(adapt_fn(a, b, None))
+        t_adapt += time.perf_counter() - t0  # jaxlint: disable=JL004
+        ff, fa = jax.device_get((up_f, up_a))
+        epes.append(float(np.sqrt(((fa - ff) ** 2).sum(-1)).mean()))
+        used.append(int(jax.device_get(iu)[0]))
+        deltas.append(float(jax.device_get(fd)[0]))
+    mean_used = float(np.mean(used))
+    print(f"[adaptive] epe_vs_fixed {np.mean(epes):.4f} px, iters_used "
+          f"mean {mean_used:.1f}/{args.iters} "
+          f"(p99 {np.percentile(used, 99):.1f}), final_delta mean "
+          f"{np.mean(deltas):.2e}; per-pair fixed "
+          f"{t_fixed / len(pairs) * 1e3:.1f} ms vs adaptive "
+          f"{t_adapt / len(pairs) * 1e3:.1f} ms", file=sys.stderr)
+
+    # -- phase 3: overload, fixed service vs adaptive service -------------
+    def make_service(eval_fn, adaptive: bool) -> FlowService:
+        engine = InferenceEngine(
+            eval_fn,
+            ServeConfig(batch_size=args.batch, mode="sintel",
+                        bucket_multiple=args.bucket_multiple,
+                        inflight=args.inflight, adaptive=adaptive),
+            mesh=mesh)
+        svc = FlowService(engine, port=0, slo_ms=args.slo_ms,
+                          max_queue=args.max_queue,
+                          request_timeout_s=60.0,
+                          max_iters=args.iters, min_iters=min_iters)
+        svc.start()
+        _client_thread(*svc.address, body, 1, [], [])
+        svc.reset_stats()
+        return svc
+
+    svc_fixed = make_service(fixed_fn, adaptive=False)
+    # capacity probe on the FIXED service sets one shared offered rate:
+    # both overload runs face the same open-arrival pressure
+    level = _run_level(svc_fixed, body, args.concurrency, args.requests)
+    offered = args.overload_factor * max(level["goodput_rps"], 0.5)
+    overload_fixed = _run_overload(svc_fixed, body, offered,
+                                   args.overload_duration_s)
+    svc_fixed.drain_and_stop()
+
+    svc_adapt = make_service(adapt_fn, adaptive=True)
+    stats: dict = {}
+    ov = _run_overload(svc_adapt, body, offered, args.overload_duration_s,
+                       stats_out=stats)
+    svc_adapt.drain_and_stop()
+    sched = stats.get("scheduler", {})
+    overload_adaptive = dict(
+        ov,
+        iter_budget_p50=sched.get("iter_budget_p50"),
+        iter_budget_p99=sched.get("iter_budget_p99"),
+        iters_used_mean=stats.get("engine", {}).get("iters_used_mean"),
+    )
+    print(f"[adaptive overload] budgets p50 "
+          f"{overload_adaptive['iter_budget_p50']} / p99 "
+          f"{overload_adaptive['iter_budget_p99']} (full {args.iters}), "
+          f"goodput {ov['goodput_rps']} vs fixed "
+          f"{overload_fixed['goodput_rps']} req/s", file=sys.stderr)
+
+    record = {
+        "metric": "serve_adaptive",
+        "platform": jax.devices()[0].platform,
+        "variant": args.variant + ("-small" if args.small else ""),
+        "iters": args.iters,
+        "size": args.size,
+        "frames": args.frames,
+        "batch": args.batch,
+        "slo_ms": args.slo_ms,
+        "max_queue": args.max_queue,
+        "converge_tol": tol,
+        "min_iters": min_iters,
+        "corr_impl_resolved": args.corr_impl_resolved,
+        "epe_vs_fixed_px": round(float(np.mean(epes)), 4),
+        "mean_iters_used": round(mean_used, 2),
+        "p99_iters_used": round(float(np.percentile(used, 99)), 2),
+        # the early-exit win: % of the fixed iteration count NOT spent
+        "iters_drop_pct": round(100.0 * (1.0 - mean_used / args.iters), 1),
+        "mean_final_delta": round(float(np.mean(deltas)), 6),
+        "fixed_ms_per_pair": round(t_fixed / len(pairs) * 1e3, 2),
+        "adaptive_ms_per_pair": round(t_adapt / len(pairs) * 1e3, 2),
+        "overload_fixed": overload_fixed,
+        "overload_adaptive": overload_adaptive,
+        "overload_goodput_ratio": (
+            round(ov["goodput_rps"] / overload_fixed["goodput_rps"], 3)
+            if overload_fixed["goodput_rps"] else None),
+    }
+    assert set(record) == ADAPTIVE_RECORD_KEYS, \
+        sorted(set(record) ^ ADAPTIVE_RECORD_KEYS)
+    assert set(overload_fixed) == OVERLOAD_KEYS
+    assert set(overload_adaptive) == ADAPTIVE_OVERLOAD_KEYS, \
+        sorted(set(overload_adaptive) ^ ADAPTIVE_OVERLOAD_KEYS)
     print(json.dumps(record), flush=True)
 
 
@@ -1004,17 +1249,27 @@ def main() -> int:
         signal.signal(s, _on_term)
 
     last = [time.monotonic()]
+    # shared watchdog-relay hygiene (bench.py): the XLA host-feature
+    # warning goes to a side log once, never into the relayed stderr —
+    # the queue's recorded tail must end with the JSON metric line
+    from bench import make_stderr_filter
 
-    def pump(src, dst):
+    warn_filt = make_stderr_filter(tag="serve_bench")
+
+    def pump(src, dst, is_stderr=False):
         for line in iter(src.readline, b""):
             last[0] = time.monotonic()
+            if is_stderr:
+                line = warn_filt(line)
+                if line is None:
+                    continue
             dst.buffer.write(line)
             dst.flush()
 
     threads = [
         threading.Thread(target=pump, args=(child.stdout, sys.stdout),
                          daemon=True),
-        threading.Thread(target=pump, args=(child.stderr, sys.stderr),
+        threading.Thread(target=pump, args=(child.stderr, sys.stderr, True),
                          daemon=True),
     ]
     for t in threads:
@@ -1061,6 +1316,7 @@ if __name__ == "__main__":
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        (_measure_closed_loop if _args.closed_loop else _measure)(_args)
+        (_measure_adaptive if _args.adaptive else
+         _measure_closed_loop if _args.closed_loop else _measure)(_args)
         sys.exit(0)
     sys.exit(main())

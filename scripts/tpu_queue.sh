@@ -120,7 +120,11 @@ run_all() {
   # 6. component-level forward numbers (r4 rc=124 fixed: dexined_x2
   #    config removed; warm cache)
   run micro_bench   900 python scripts/micro_bench.py
-  # 7. accuracy evidence at 10x pool (next-7): on-chip long demos for
+  # 7. adaptive-iteration serving frontier (PR 18): EPE-vs-latency +
+  #    overload goodput at the flagship geometry. serve_bench's own
+  #    watchdog (hard cap 850) ends a stuck run before this timeout.
+  run serve_adaptive 1200 env SERVE_BENCH_HARD_CAP_S=850 python scripts/serve_bench.py --adaptive --variant v5 --iters 8 --size 440x1024 --frames 8 --batch 4 --requests 32 --concurrency 8
+  # 8. accuracy evidence at 10x pool (next-7): on-chip long demos for
   #    v1-small AND the v5 flagship (42 steps/s on chip at this
   #    geometry -> compute is minutes; ckpt_dir so a mid-run tunnel
   #    death resumes instead of restarting) + edge

@@ -328,7 +328,9 @@ class TestHTTPService:
         assert stats["locks"]["order_violations"] == 0
         assert stats["locks"]["cycles"] == 0
         assert set(stats["service"]) == {
-            "uptime_s", "draining", "slo_ms", "sessions_enabled"}
+            "uptime_s", "draining", "slo_ms", "sessions_enabled",
+            "adaptive"}
+        assert stats["service"]["adaptive"] is False
         # engine blob: ServeStats + registry, incl. the bucket SHAPES
         # and compiled signature names (which geometries are hot vs
         # compiling — the BucketRegistry.stats() satellite)

@@ -517,7 +517,8 @@ class TestFsdpAuditCLI:
         self._patch(monkeypatch)
         assert self._main()([]) == 0
         out = capsys.readouterr().out
-        assert "train_fsdp" in out and "4 step(s)" in out
+        # 7 = the 5 base steps + fsdp + halo legs (PR 14/16 growth)
+        assert "train_fsdp" in out and "7 step(s)" in out
 
     def test_fsdp_spec_drift_fails(self, monkeypatch, capsys):
         def mutate(r):
