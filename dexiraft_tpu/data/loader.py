@@ -59,6 +59,23 @@ def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
     return order
 
 
+def world_compatible(batch_size: int, process_count: int) -> Optional[str]:
+    """None when ``process_count`` hosts can slice a ``batch_size``
+    global batch, else a one-line reason. The Loader constructor raises
+    the same condition; this form lets elastic membership
+    (resilience.membership) refuse a shrink target BEFORE tearing the
+    old world down — the global-batch offsets in the stream sidecars
+    are host-count-invariant precisely because every world slices the
+    SAME global batch, so a world that cannot slice it evenly is not a
+    resize, it is a different run."""
+    if process_count < 1:
+        return f"process_count must be positive, got {process_count}"
+    if batch_size % process_count:
+        return (f"global batch {batch_size} must divide over "
+                f"{process_count} hosts")
+    return None
+
+
 def _stack(samples) -> Batch:
     keys = [k for k in samples[0] if k != "extra_info"]
     return {k: np.stack([s[k] for s in samples]) for k in keys}

@@ -57,3 +57,138 @@ def _env_int(name: str) -> int:
             f"multi-host init: coordinator address was given but {name} "
             "is not set (and no explicit argument was passed)")
     return int(value)
+
+
+# --- elastic runtime lifecycle (resilience.membership) ---------------------
+#
+# jax.distributed.initialize is once-per-process by design: its client is
+# constructed with the DEFAULT missed-heartbeat behavior (terminate the
+# process when the coordination service reports ANY peer in error — see
+# xla pjrt distributed client.h), and State.initialize refuses a second
+# call. Elastic membership needs the opposite on both counts: a survivor
+# must OUTLIVE a dead peer, then tear the whole runtime down and
+# re-initialize at the new world size. The helpers below mirror
+# jax._src.distributed.State.initialize/shutdown with three deliberate
+# differences, each validated against this container's jax 0.4.37:
+#
+#   * service AND client heartbeats are relaxed to effectively-never
+#     (max_missing_heartbeats ~ 1e5): the coordination service never
+#     declares a silent peer dead, so it never propagates the fatal
+#     error that the default client answers with process termination
+#     (the custom missed_heartbeat_callback escape hatch is unusable
+#     here — this jaxlib's binding cannot convert the absl::Status
+#     argument and aborts with std::bad_cast). Liveness detection moves
+#     wholesale to the KV-store leases the membership runtime owns,
+#     where a missed lease is a catchable verdict, not a SIGABRT.
+#   * the client is built with shutdown_on_destruction=False and a small
+#     shutdown_timeout, so teardown against DEAD peers is bounded: the
+#     explicit client.shutdown() below stops the client's error-polling
+#     thread FIRST (shutting the service down under a live poller is the
+#     other path to the fatal callback), fails its shutdown barrier
+#     after shutdown_timeout at worst, and never hangs or aborts.
+#   * teardown clears jax's backend caches (xla_bridge process_count /
+#     local_devices lru_caches included — stale entries otherwise leak
+#     the OLD world size into orbax's barrier participation decisions)
+#     so the next elastic_initialize presents the new world to
+#     jax.process_count()/jax.devices() consistently on every member.
+
+_ELASTIC_HEARTBEAT_INTERVAL_S = 10
+_ELASTIC_MAX_MISSING_HEARTBEATS = 100_000
+
+
+def elastic_initialize(coordinator_address: str, num_processes: int,
+                       process_id: int, *, start_service: bool,
+                       init_timeout_s: int = 60,
+                       shutdown_timeout_s: int = 5) -> None:
+    """Install a survivable distributed runtime (see block comment).
+
+    Safe to call repeatedly with elastic_teardown between calls — that
+    pair is exactly one membership epoch transition. ``start_service``
+    is True on the epoch's rank 0 (the coordinator host).
+    """
+    from jax._src import distributed
+    from jaxlib import xla_extension
+
+    st = distributed.global_state
+    if st.client is not None:
+        raise RuntimeError(
+            "elastic_initialize: a distributed runtime is already "
+            "installed — elastic_teardown() first (one epoch at a time)")
+    if start_service:
+        st.service = xla_extension.get_distributed_runtime_service(
+            "[::]:" + coordinator_address.rsplit(":", 1)[1],
+            int(num_processes),
+            heartbeat_interval=_ELASTIC_HEARTBEAT_INTERVAL_S,
+            max_missing_heartbeats=_ELASTIC_MAX_MISSING_HEARTBEATS)
+    st.coordinator_address = coordinator_address
+    st.num_processes = int(num_processes)
+    st.process_id = int(process_id)
+    client = xla_extension.get_distributed_runtime_client(
+        coordinator_address, int(process_id),
+        init_timeout=int(init_timeout_s),
+        shutdown_timeout=int(shutdown_timeout_s),
+        heartbeat_interval=_ELASTIC_HEARTBEAT_INTERVAL_S,
+        max_missing_heartbeats=_ELASTIC_MAX_MISSING_HEARTBEATS,
+        shutdown_on_destruction=False, use_compression=True)
+    client.connect()
+    st.client = client
+    st.preemption_sync_manager = (
+        xla_extension.create_preemption_sync_manager())
+    st.preemption_sync_manager.initialize(client)
+
+
+def elastic_teardown(graceful: bool = True) -> None:
+    """Dismantle the current distributed runtime so a new epoch can
+    initialize at a different size.
+
+    graceful=False is the shrink path (peers are DEAD): the client
+    shutdown still runs first — its barrier fails after the small
+    shutdown_timeout, but the attempt stops the error-polling thread
+    before the service goes away, which is what keeps a survivor
+    alive — and every error is swallowed. Backend caches are refreshed
+    either way; live arrays become invalid (the elastic contract:
+    state is re-restored from the checkpoint after re-initialization).
+    """
+    import gc
+
+    from jax._src import distributed
+
+    st = distributed.global_state
+    client, service = st.client, st.service
+    st.client = None
+    st.service = None
+    st.preemption_sync_manager = None
+    if client is not None:
+        try:
+            client.shutdown()
+        except Exception as e:
+            if graceful:
+                print(f"[elastic] client shutdown: {type(e).__name__}: "
+                      f"{str(e)[:120]}", flush=True)
+    del client
+    gc.collect()  # any backend-held client refs die before the service
+    if service is not None:
+        try:
+            service.shutdown()
+        except Exception as e:
+            if graceful:
+                print(f"[elastic] service shutdown: {type(e).__name__}: "
+                      f"{str(e)[:120]}", flush=True)
+    refresh_backend_world()
+
+
+def refresh_backend_world() -> None:
+    """Drop every cached view of the device world. jax rebuilds the
+    backend from jax._src.distributed.global_state on next use, so after
+    this the NEW world's process_count/process_index/devices are what
+    every consumer (orbax's barrier participation above all) observes."""
+    import jax as _jax
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    # process_count/local_devices carry their own lru_caches on top of
+    # the backend cache — stale entries here are how an incumbent kept
+    # reporting the OLD world size after re-initialization
+    xla_bridge.process_count.cache_clear()
+    xla_bridge.local_devices.cache_clear()
+    _jax.clear_caches()

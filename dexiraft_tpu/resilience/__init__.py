@@ -35,7 +35,17 @@ Pod-grade additions (multi-host failure handling):
   * watchdog.HangWatchdog — armed around each step/collective region; a
     stall past the timeout dumps step index + live stacks and exits
     nonzero instead of hanging a pod forever. Step-time EWMA straggler
-    warnings ride the same timer.
+    warnings ride the same timer. Under --elastic the first stall
+    verdict is handed to the membership runtime (one reconfiguration
+    attempt) before the exit-98 fallback.
+  * membership.MembershipRuntime — elastic pod membership: epoch-
+    numbered worlds over the KV store with per-host heartbeat leases.
+    A lost host becomes a shrink-and-continue reconfiguration (new
+    epoch, smaller mesh, agreed-step restore, re-sliced data stream)
+    instead of a job restart; a replacement host posts a join intent
+    on the FileBoard and is absorbed at the next checkpoint boundary.
+    ElasticFallback marks the cases that still need the old exit-98
+    contract (rank-0 loss, cascade below --min_hosts).
 
 The data-pipeline half (bounded retry-with-backoff, skip-and-count,
 decode-pool rebuild) lives in data.loader — PipelineStats is re-exported
@@ -43,7 +53,15 @@ here for the one-stop import.
 """
 
 from dexiraft_tpu.data.loader import PipelineStats
-from dexiraft_tpu.resilience.coord import Coordinator
+from dexiraft_tpu.resilience.coord import Coordinator, CoordinatorTimeout
+from dexiraft_tpu.resilience.membership import (
+    ElasticConfig,
+    ElasticFallback,
+    EpochInfo,
+    FileBoard,
+    MembershipRuntime,
+    ReconfigureNeeded,
+)
 from dexiraft_tpu.resilience.preemption import PreemptionHandler
 from dexiraft_tpu.resilience.watchdog import STALL_EXIT_CODE, HangWatchdog
 from dexiraft_tpu.resilience.retention import RetentionPolicy
@@ -57,6 +75,7 @@ from dexiraft_tpu.resilience.stream import (
 from dexiraft_tpu.resilience.verify import (
     CheckpointIntegrityError,
     clean_uncommitted,
+    prune_steps_above,
     restore_verified,
     uncommitted_flushes,
     verify_state,
@@ -65,7 +84,14 @@ from dexiraft_tpu.resilience.verify import (
 __all__ = [
     "CheckpointIntegrityError",
     "Coordinator",
+    "CoordinatorTimeout",
+    "ElasticConfig",
+    "ElasticFallback",
+    "EpochInfo",
+    "FileBoard",
     "HangWatchdog",
+    "MembershipRuntime",
+    "ReconfigureNeeded",
     "LoaderKindMismatch",
     "PipelineStats",
     "PreemptionHandler",
@@ -75,6 +101,7 @@ __all__ = [
     "clean_uncommitted",
     "delete_position",
     "load_position",
+    "prune_steps_above",
     "restore_verified",
     "save_position",
     "uncommitted_flushes",

@@ -34,9 +34,40 @@ global, the watchdog bounds the wait when a peer can no longer vote.
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
+
+
+class CoordinatorTimeout(RuntimeError):
+    """A peer's consensus value never arrived within ``timeout_s``.
+
+    Raised instead of the raw gRPC DEADLINE_EXCEEDED traceback so the
+    operator (and the elastic membership runtime) sees WHICH peer of
+    WHICH round went silent in one line. Under ``--elastic`` this is a
+    reconfiguration trigger (resilience.membership); otherwise it is
+    fatal with an actionable message.
+    """
+
+    def __init__(self, namespace: str, round_id: int, peer: int,
+                 timeout_s: float):
+        super().__init__(
+            f"consensus timeout: peer {peer} posted no value for round "
+            f"{round_id} of namespace '{namespace}' within "
+            f"{timeout_s:.0f}s — the host is dead, stalled, or "
+            f"partitioned (elastic runs reconfigure; others should "
+            f"restart the pod)")
+        self.namespace = namespace
+        self.round_id = round_id
+        self.peer = peer
+        self.timeout_s = timeout_s
+
+
+def _is_deadline(exc: BaseException) -> bool:
+    """DEADLINE_EXCEEDED from the coordination service (vs a real
+    transport/coordinator failure, which must keep its own traceback)."""
+    return "DEADLINE_EXCEEDED" in str(exc)
 
 
 class Coordinator:
@@ -61,6 +92,17 @@ class Coordinator:
         self.namespace = namespace
         self.timeout_s = float(timeout_s)
         self._round = 0
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _readers(self) -> ThreadPoolExecutor:
+        """Lazy per-Coordinator pool for the concurrent peer reads (one
+        blocking gRPC get per peer; capped so a 6000-host pod does not
+        spawn 6000 idle threads per process)."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(self.size, 16),
+                thread_name_prefix=f"coord[{self.namespace}]")
+        return self._pool
 
     def _allgather(self, value: np.ndarray) -> np.ndarray:
         """(size, 1) array of every host's scalar.
@@ -94,9 +136,26 @@ class Coordinator:
         v = int(np.asarray(value).ravel()[0])
         client.key_value_set(f"{self.namespace}/{rid}/{self.index}", str(v))
         timeout_ms = max(1000, int(self.timeout_s * 1000))
-        vals = [int(client.blocking_key_value_get(
-            f"{self.namespace}/{rid}/{i}", timeout_ms))
-            for i in range(self.size)]
+
+        # concurrent peer reads: the sequential scan made a slow peer at
+        # index 0 serialize detection of everything behind it (the worst
+        # case paid size x timeout_s); concurrently every peer gets the
+        # SAME timeout_s window and the slowest single peer bounds the
+        # round. Index order is preserved in the gathered array.
+        def read(i: int) -> int:
+            try:
+                return int(client.blocking_key_value_get(
+                    f"{self.namespace}/{rid}/{i}", timeout_ms))
+            except Exception as e:
+                if _is_deadline(e):
+                    raise CoordinatorTimeout(self.namespace, rid, i,
+                                             self.timeout_s) from None
+                raise
+
+        if self.size <= 1:
+            vals = [read(0)]
+        else:
+            vals = list(self._readers().map(read, range(self.size)))
         # bounded KV footprint over multi-day runs: completing round
         # rid proves every host finished READING round rid-1 (the calls
         # are lockstep), so each host's own rid-1 key is globally

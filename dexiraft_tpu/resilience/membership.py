@@ -1,0 +1,643 @@
+"""Elastic pod membership: shrink-and-continue, grow-at-checkpoint.
+
+Losing 1/64 hosts used to cost the whole job: the watchdog bounded the
+hang and exited 98, the orchestrator cold-restarted every host, and the
+pod re-paid init + compile + restore. Every mechanism needed to do
+better already exists in this tree — KV-store consensus
+(resilience.coord), template-driven sharded restore that reshards
+across mesh shapes (train.checkpoint), centralized mesh construction
+(parallel.layout.make_train_mesh), the pinned epoch_permutation
+data-order contract, and the exact-resume stream sidecars
+(resilience.stream). This module composes them into the standard
+large-pod resilience pattern: membership EPOCHS.
+
+An epoch is one fixed world: (epoch number, member set, coordinator
+address, jax.distributed runtime at that size). All coordination state
+is namespaced by epoch — leases under ``dexiraft/elastic/e{E}/``,
+consensus under ``dexiraft/coord/e{E}`` (:meth:`MembershipRuntime
+.coord_namespace`) — so a straggler's stale keys from epoch E can
+never pollute epoch E+1's rounds. Within an epoch each host holds a
+heartbeat LEASE: a tiny monotonic counter re-published to the KV store
+every ``lease_interval_s`` by a daemon thread that also probes every
+peer's counter. A counter that stops advancing for ``lease_timeout_s``
+is a missed lease — the host is dead, wedged, or partitioned — and
+:meth:`MembershipRuntime.poll` turns it into a typed verdict:
+
+  * :class:`ReconfigureNeeded` — survivors can re-form without the
+    suspect(s): run :meth:`MembershipRuntime.reconfigure`.
+  * :class:`ElasticFallback` — reconfiguration is impossible (the
+    epoch's rank 0 — the host carrying the coordination service — is
+    the casualty, the surviving set would fall below ``min_hosts``, or
+    the new world cannot slice the global batch): exit 98 and let the
+    orchestrator restart, exactly the pre-elastic behavior.
+
+Reconfiguration (shrink) runs entirely over the OLD epoch's still-live
+KV store: every survivor posts an ``alive`` key, waits bounded-time for
+every non-suspect peer (a peer can be stuck in a collective against
+the dead host until its own op timeout — set ``reconfig_timeout_s``
+above ``--coord_timeout_s``), and the tentative member set is then
+CONFIRMED by a consensus round (coord.min_int/any_flag over a hash of
+the sorted plan, in a plan-sized Coordinator under the epoch's
+``confirm`` namespace): any disagreement — a straggler that revived
+late, a partition that healed mid-round — downgrades to
+ElasticFallback rather than risking split-brain. Only then does the
+irreversible part start: checkpoint machinery abandoned without
+barriers (train.checkpoint.reset_managers — a zombie flush against the
+dead host must not be waited on), the distributed runtime torn down
+dead-peer-safe (parallel.distributed.elastic_teardown), and epoch E+1
+initialized at the new size on a NEW port (``port_base + E+1`` on the
+new rank 0's host, so a half-dead straggler still bound to the old
+port can never be mistaken for a member). The caller (train_cli's
+elastic segment loop, or the test child) then re-forms the mesh from
+layout.make_train_mesh over the new world, re-restores the agreed step
+through coord.agree_step onto the NEW template's resolved shardings,
+prunes any step a zombie flush may commit above the agreement
+(resilience.verify.prune_steps_above), re-slices the data stream at
+the new host count from the agreed (epoch, offset) sidecar, and
+continues. Seconds, not a job restart.
+
+Growth is symmetric and cheaper: a replacement host posts a join
+intent on the :class:`FileBoard` (a filesystem rendezvous under the
+shared checkpoint directory — the one channel that exists BEFORE a
+joiner has any KV access), incumbents observe it at the next
+checkpoint boundary (a collective any_flag decision, so every
+incumbent reconfigures at the same step), rank 0 assigns the joiners
+ranks above the incumbents and announces epoch E+1 on the board, and
+everyone — incumbents gracefully torn down, joiners fresh — meets in
+the new, larger world. The joiner restores the same agreed checkpoint
+step as everyone else; nothing restarts.
+
+Why the board AND the KV store: the KV store dies with its epoch (and
+with rank 0), so it cannot carry cross-epoch state; the board is
+durable but has no ordering guarantees, so it carries only rendezvous
+facts (the latest epoch announcement, pending join intents) — never
+votes. Votes happen in exactly one place, the confirm round.
+
+The jax.distributed runtime this rides must never beat the leases to a
+verdict: elastic worlds are initialized with effectively-disabled
+coordination-service heartbeats (parallel.distributed.elastic_initialize
+has the full story, including why the missed-heartbeat callback cannot
+be used on this jaxlib), making the lease the ONLY failure detector —
+one detector, one timeout, one reconfiguration policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import os.path as osp
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
+
+from dexiraft_tpu.analysis.locks import OrderedLock
+from dexiraft_tpu.resilience.coord import Coordinator
+
+_ELASTIC_NS = "dexiraft/elastic"
+
+
+class ReconfigureNeeded(RuntimeError):
+    """A membership change is required and possible: ``dead`` holds the
+    suspected member indices (empty for a stall-verdict re-form at the
+    same size). Raised by :meth:`MembershipRuntime.poll`; the caller
+    pauses at the step boundary and runs
+    :meth:`MembershipRuntime.reconfigure`."""
+
+    def __init__(self, reason: str, dead: Optional[Set[int]] = None):
+        self.reason = reason
+        self.dead = set(dead or ())
+        super().__init__(
+            f"membership reconfiguration needed: {reason}"
+            + (f" (suspect member(s) {sorted(self.dead)})"
+               if self.dead else ""))
+
+
+class ElasticFallback(RuntimeError):
+    """Elastic recovery is not possible from here; the caller falls back
+    to the watchdog's exit-98-and-restart contract (the orchestrator
+    restarts the whole pod)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochInfo:
+    """One installed membership epoch — what the caller re-forms from."""
+
+    epoch: int
+    size: int
+    index: int
+    coordinator_address: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Knobs for the membership runtime.
+
+    ``host`` is THIS host's address as peers should dial it (the new
+    coordination service binds here when this host becomes an epoch's
+    rank 0). ``board_dir`` must be on storage every member AND every
+    future joiner can reach — the checkpoint directory's filesystem is
+    the natural choice. ``global_batch`` (when known) lets shrink
+    refuse a world that cannot slice the batch BEFORE tearing anything
+    down. ``reconfig_timeout_s`` must exceed the consensus timeout
+    (``--coord_timeout_s``): a survivor may legitimately arrive at the
+    reconfiguration round only after its in-flight consensus op times
+    out against the dead peer."""
+
+    host: str
+    board_dir: str
+    min_hosts: int = 1
+    global_batch: Optional[int] = None
+    lease_interval_s: float = 0.5
+    lease_timeout_s: float = 4.0
+    probe_timeout_s: float = 1.0
+    reconfig_timeout_s: float = 30.0
+    join_poll_s: float = 0.5
+    join_timeout_s: float = 300.0
+    stall_grace_s: float = 60.0
+    init_timeout_s: int = 60
+
+
+# --------------------------------------------------------------------------
+# FileBoard — the cross-epoch rendezvous (see module docstring)
+# --------------------------------------------------------------------------
+
+
+class FileBoard:
+    """Filesystem rendezvous: epoch announcements + join intents.
+
+    Every write is atomic (tmp + rename on the same filesystem), every
+    read tolerates absence — the board carries FACTS a reader polls
+    for, never votes. Lives under a directory all members and joiners
+    share (conventionally ``<ckpt_dir>/membership``)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(osp.join(directory, "join"), exist_ok=True)
+
+    # -- epoch announcements (rank 0 writes, everyone reads) ------------
+    def announce_epoch(self, epoch: int, coordinator_address: str,
+                       size: int, join_ranks: Dict[str, int]) -> None:
+        record = {"epoch": int(epoch),
+                  "coordinator_address": coordinator_address,
+                  "size": int(size),
+                  "join_ranks": {str(k): int(v)
+                                 for k, v in join_ranks.items()}}
+        path = osp.join(self.directory, "epoch.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+
+    def read_epoch(self) -> Optional[dict]:
+        try:
+            with open(osp.join(self.directory, "epoch.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # -- join intents (joiners write, incumbents read/clear) ------------
+    def post_join(self, name: str, host: str) -> None:
+        path = osp.join(self.directory, "join", f"{name}.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"name": str(name), "host": str(host)}, f)
+        os.replace(tmp, path)
+
+    def list_joins(self) -> List[dict]:
+        """Pending join intents, sorted by name (the rank-assignment
+        order, so every incumbent derives the same plan)."""
+        join_dir = osp.join(self.directory, "join")
+        try:
+            names = sorted(n for n in os.listdir(join_dir)
+                           if n.endswith(".json"))
+        except OSError:
+            return []
+        records = []
+        for n in names:
+            try:
+                with open(osp.join(join_dir, n)) as f:
+                    records.append(json.load(f))
+            except (OSError, ValueError):
+                continue  # half-written intent: next boundary picks it up
+        return records
+
+    def clear_joins(self, names: List[str]) -> None:
+        for name in names:
+            try:
+                os.remove(osp.join(self.directory, "join", f"{name}.json"))
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------------
+# MembershipRuntime
+# --------------------------------------------------------------------------
+
+
+class MembershipRuntime:
+    """Epoch-numbered membership over the jax.distributed KV store.
+
+    Lifecycle: :meth:`bootstrap` (initial members) or :meth:`join`
+    (replacement hosts) installs epoch 0 / the announced epoch; the
+    training loop calls :meth:`poll` at its consensus cadence and
+    :meth:`reconfigure` when poll (or a CoordinatorTimeout from a
+    consensus op) says the world changed; :meth:`absorb_joins` runs at
+    checkpoint boundaries. ``events`` accumulates one record per
+    reconfiguration — kind, epoch, member plan, and ``recovery_s``
+    (verdict-to-new-world wall time, the number the chaos-smoke phase
+    compares against the exit-98-and-restart baseline)."""
+
+    def __init__(self, config: ElasticConfig):
+        self.config = config
+        self.board = FileBoard(config.board_dir)
+        self.epoch = -1
+        self.size = 0
+        self.index = -1
+        self.coordinator_address = ""
+        self._port_base: int = 0
+        self.events: "list[dict]" = []
+        self._lock = OrderedLock("resilience.membership.state")
+        self._suspects: Set[int] = set()
+        self._coordinator_lost: Optional[str] = None
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+        self._stall_verdict: Optional[Tuple[int, str]] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def bootstrap(self, coordinator_address: str, size: int,
+                  index: int) -> EpochInfo:
+        """Install epoch 0 for an initial member. The epoch-0 address
+        doubles as the port base: epoch E's coordination service binds
+        ``port_base + E`` so no stale listener is ever redialed."""
+        self._port_base = int(coordinator_address.rsplit(":", 1)[1])
+        return self._install_epoch(0, coordinator_address, size, index,
+                                   announce_joins={})
+
+    def join(self, name: str) -> EpochInfo:
+        """Replacement-host entry: post the intent, wait for the epoch
+        announcement that assigns this name a rank, and enter that
+        world. The checkpoint-boundary cadence of absorption is the
+        incumbents' side (:meth:`absorb_joins`)."""
+        self.board.post_join(name, self.config.host)
+        deadline = time.monotonic() + self.config.join_timeout_s
+        while True:
+            record = self.board.read_epoch()
+            if record and name in record.get("join_ranks", {}):
+                break
+            if time.monotonic() > deadline:
+                raise ElasticFallback(
+                    f"join intent '{name}' was not absorbed within "
+                    f"{self.config.join_timeout_s:.0f}s — no incumbent "
+                    f"reached a checkpoint boundary (or none is running "
+                    f"--elastic)")
+            time.sleep(self.config.join_poll_s)
+        addr = record["coordinator_address"]
+        self._port_base = (int(addr.rsplit(":", 1)[1])
+                           - int(record["epoch"]))
+        return self._install_epoch(
+            int(record["epoch"]), addr, int(record["size"]),
+            int(record["join_ranks"][name]), announce_joins=None)
+
+    def close(self) -> None:
+        """Stop the lease thread (teardown of the runtime itself is the
+        caller's shutdown path — membership only ever replaces worlds,
+        it does not own the final exit)."""
+        self._stop_leases()
+
+    # -- verdicts --------------------------------------------------------
+    def poll(self) -> None:
+        """Raise the current membership verdict, if any (called at the
+        training loop's consensus cadence — cheap: one lock, no RPC;
+        the RPCs live on the lease thread)."""
+        with self._lock:
+            lost = self._coordinator_lost
+            suspects = set(self._suspects)
+        if lost:
+            raise ElasticFallback(
+                f"epoch {self.epoch}: coordination KV store unreachable "
+                f"({lost}) — the epoch's rank 0 host is gone and the "
+                f"member set cannot be renegotiated without it")
+        if suspects:
+            if 0 in suspects:
+                raise ElasticFallback(
+                    f"epoch {self.epoch}: rank 0 (the coordination "
+                    f"service host) missed its lease — survivors have "
+                    f"no KV store to agree a new member set over")
+            raise ReconfigureNeeded(
+                f"epoch {self.epoch}: missed lease", dead=suspects)
+
+    def notify_stall(self, step: int, region: str) -> float:
+        """Watchdog handoff (HangWatchdog.on_stall): record the verdict
+        and grant one grace window. The stalled main thread is expected
+        to unblock via its own op timeout (CoordinatorTimeout at
+        --coord_timeout_s) and reach reconfigure(); if it never does,
+        the watchdog's second fire exits 98 as before."""
+        with self._lock:
+            self._stall_verdict = (int(step), str(region))
+        print(f"[elastic] watchdog stall verdict at step {step} in "
+              f"armed region '{region}' (epoch {self.epoch}) — holding "
+              f"exit for one reconfiguration attempt", flush=True)
+        return self.config.stall_grace_s
+
+    def pending_joins(self) -> List[dict]:
+        """Join intents awaiting absorption (checkpoint boundaries gate
+        on any_flag(bool(...)) of this, so absorption is collective)."""
+        return self.board.list_joins()
+
+    def coord_namespace(self) -> str:
+        """The consensus namespace for the CURRENT epoch: a fresh
+        Coordinator namespace per epoch means stale round keys from a
+        previous world can never collide with the new one's rounds."""
+        return f"dexiraft/coord/e{self.epoch}"
+
+    # -- reconfiguration -------------------------------------------------
+    def reconfigure(self, dead: Optional[Set[int]] = None,
+                    reason: str = "missed lease") -> EpochInfo:
+        """Shrink (or same-size re-form) into epoch+1 without the dead
+        members. Runs the full protocol from the module docstring;
+        raises ElasticFallback when the new world is not viable or the
+        survivors cannot agree. On return the jax.distributed runtime
+        IS the new world — the caller re-forms mesh/state/stream."""
+        t0 = time.monotonic()
+        with self._lock:
+            dead = set(dead or ()) | set(self._suspects)
+            stall = self._stall_verdict
+            self._stall_verdict = None
+        if stall is not None:
+            reason = (f"{reason}; stall in region '{stall[1]}' at step "
+                      f"{stall[0]}")
+        self._stop_leases()
+        plan = self._agree_survivors(dead)
+        self._check_viable(plan)
+        new_rank = plan.index(self.index)
+        new_host = self._host_of(plan[0])
+        new_epoch = self.epoch + 1
+        new_addr = f"{new_host}:{self._port_base + new_epoch}"
+        print(f"[elastic] epoch {self.epoch} -> {new_epoch}: shrinking "
+              f"{self.size} -> {len(plan)} members ({reason}); survivors "
+              f"{plan}, new coordinator {new_addr}", flush=True)
+        self._teardown(graceful=False)
+        info = self._install_epoch(new_epoch, new_addr, len(plan),
+                                   new_rank, announce_joins={})
+        recovery_s = time.monotonic() - t0
+        self.events.append({"kind": "shrink", "epoch": new_epoch,
+                            "members": plan, "reason": reason,
+                            "recovery_s": recovery_s})
+        print(f"[elastic] epoch {new_epoch} up: {len(plan)} member(s), "
+              f"rank {new_rank}, recovery {recovery_s:.2f}s", flush=True)
+        return info
+
+    def absorb_joins(self) -> EpochInfo:
+        """Grow into epoch+1 with every pending join intent (checkpoint
+        boundary, ALL incumbents — the caller has already agreed
+        collectively that joins are pending and all async saves are
+        committed, so the graceful teardown's barriers are safe)."""
+        t0 = time.monotonic()
+        self._stop_leases()
+        client = self._client()
+        ens = self._ens()
+        if self.index == 0:
+            joins = self.board.list_joins()
+            join_ranks = {j["name"]: self.size + k
+                          for k, j in enumerate(joins)}
+            plan_record = {"size": self.size + len(joins),
+                           "join_ranks": join_ranks}
+            client.key_value_set(f"{ens}/grow_plan",
+                                 json.dumps(plan_record),
+                                 allow_overwrite=True)
+        else:
+            # non-rank-0 incumbents take rank 0's plan verbatim: board
+            # reads race with late intents, a KV value does not
+            plan_record = json.loads(client.blocking_key_value_get(
+                f"{ens}/grow_plan",
+                int(self.config.reconfig_timeout_s * 1000)))
+        new_size = int(plan_record["size"])
+        join_ranks = plan_record["join_ranks"]
+        self._check_viable(list(range(new_size)))
+        new_epoch = self.epoch + 1
+        new_addr = (f"{self._host_of(0)}:{self._port_base + new_epoch}")
+        print(f"[elastic] epoch {self.epoch} -> {new_epoch}: growing "
+              f"{self.size} -> {new_size} members (absorbing "
+              f"{sorted(join_ranks)}), new coordinator {new_addr}",
+              flush=True)
+        self._teardown(graceful=True)
+        info = self._install_epoch(new_epoch, new_addr, new_size,
+                                   self.index, announce_joins=join_ranks)
+        if info.index == 0:
+            self.board.clear_joins(sorted(join_ranks))
+        recovery_s = time.monotonic() - t0
+        self.events.append({"kind": "grow", "epoch": new_epoch,
+                            "members": list(range(new_size)),
+                            "join_ranks": join_ranks,
+                            "recovery_s": recovery_s})
+        print(f"[elastic] epoch {new_epoch} up: {new_size} member(s), "
+              f"rank {info.index}, recovery {recovery_s:.2f}s",
+              flush=True)
+        return info
+
+    # -- internals -------------------------------------------------------
+    def _ens(self, epoch: Optional[int] = None) -> str:
+        return f"{_ELASTIC_NS}/e{self.epoch if epoch is None else epoch}"
+
+    def _client(self):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise ElasticFallback(
+                "no live distributed runtime (torn down but never "
+                "re-initialized?) — cannot run membership protocol")
+        return client
+
+    def _host_of(self, member: int) -> str:
+        """A member's dialable host, published at epoch install."""
+        if member == self.index:
+            return self.config.host
+        return self._client().blocking_key_value_get(
+            f"{self._ens()}/host/{member}",
+            int(self.config.reconfig_timeout_s * 1000))
+
+    def _check_viable(self, plan: List[int]) -> None:
+        if len(plan) < self.config.min_hosts:
+            raise ElasticFallback(
+                f"new member set {plan} is below --min_hosts "
+                f"{self.config.min_hosts} — cascading loss; restarting "
+                f"the pod is the right call")
+        gb = self.config.global_batch
+        if gb is not None and gb % len(plan):
+            raise ElasticFallback(
+                f"global batch {gb} does not divide over {len(plan)} "
+                f"host(s) — the data plane cannot re-slice to this "
+                f"world (pick a batch size divisible by every member "
+                f"count down to --min_hosts)")
+
+    def _agree_survivors(self, dead: Set[int]) -> List[int]:
+        """The shrink agreement round over the OLD epoch's KV store:
+        post alive, collect peers bounded-time, confirm the plan hash
+        by consensus. Returns the sorted agreed member list (old
+        indices)."""
+        client = self._client()
+        ens = self._ens()
+        try:
+            client.key_value_set(f"{ens}/alive/{self.index}", "1",
+                                 allow_overwrite=True)
+        except Exception as e:
+            raise ElasticFallback(
+                f"cannot reach the epoch {self.epoch} KV store to post "
+                f"liveness ({type(e).__name__}) — rank 0 is gone") \
+                from None
+        plan = [self.index]
+        for i in range(self.size):
+            if i == self.index:
+                continue
+            # suspects get one probe interval to contradict the lease
+            # verdict; non-suspects may be stuck in a collective against
+            # the dead host until their own op timeout, so they get the
+            # full reconfiguration window to arrive
+            timeout_s = (self.config.probe_timeout_s if i in dead
+                         else self.config.reconfig_timeout_s)
+            try:
+                client.blocking_key_value_get(f"{ens}/alive/{i}",
+                                              int(timeout_s * 1000))
+                plan.append(i)
+            except Exception as e:
+                if "DEADLINE_EXCEEDED" not in str(e):
+                    raise ElasticFallback(
+                        f"epoch {self.epoch} KV store failed mid-"
+                        f"agreement ({type(e).__name__}: "
+                        f"{str(e)[:120]})") from None
+        plan.sort()
+        # confirm: every survivor must hold the IDENTICAL plan before
+        # anything irreversible happens. min_int of the plan hash plus
+        # any_flag of disagreement is exactly coord's primitives — run
+        # in a plan-shaped Coordinator under the epoch's confirm
+        # namespace so only planned members vote.
+        digest = zlib.crc32(json.dumps(plan).encode())
+        confirm = Coordinator(
+            size=len(plan), index=plan.index(self.index),
+            namespace=f"{ens}/confirm",
+            timeout_s=self.config.reconfig_timeout_s)
+        try:
+            agreed = confirm.min_int(digest)
+            mismatch = confirm.any_flag(agreed != digest)
+        except Exception as e:
+            raise ElasticFallback(
+                f"survivor confirmation round failed "
+                f"({type(e).__name__}: {str(e)[:160]}) — a planned "
+                f"survivor died during reconfiguration") from None
+        if mismatch:
+            raise ElasticFallback(
+                f"survivors computed different member sets (mine: "
+                f"{plan}) — a suspect revived mid-round or the "
+                f"partition is asymmetric; refusing to risk split-brain")
+        return plan
+
+    def _teardown(self, graceful: bool) -> None:
+        from dexiraft_tpu.parallel.distributed import elastic_teardown
+        from dexiraft_tpu.train.checkpoint import reset_managers
+
+        reset_managers(abandon_pending=not graceful)
+        elastic_teardown(graceful=graceful)
+
+    def _install_epoch(self, epoch: int, addr: str, size: int, index: int,
+                       announce_joins: Optional[Dict[str, int]]
+                       ) -> EpochInfo:
+        """Bring up one world: announce (rank 0, before its own connect
+        blocks — joiners dial off the announcement and retry until the
+        service is up), initialize the elastic runtime, publish this
+        host's address, start the lease thread.
+
+        announce_joins=None marks a JOINER entering an already-announced
+        epoch (it must not re-announce)."""
+        from dexiraft_tpu.parallel.distributed import elastic_initialize
+
+        if index == 0 and announce_joins is not None:
+            self.board.announce_epoch(epoch, addr, size, announce_joins)
+        elastic_initialize(addr, size, index, start_service=(index == 0),
+                           init_timeout_s=self.config.init_timeout_s)
+        self.epoch = epoch
+        self.size = size
+        self.index = index
+        self.coordinator_address = addr
+        with self._lock:
+            self._suspects = set()
+            self._coordinator_lost = None
+            self._stall_verdict = None
+        self._client().key_value_set(f"{self._ens()}/host/{index}",
+                                     self.config.host,
+                                     allow_overwrite=True)
+        self._start_leases()
+        return EpochInfo(epoch, size, index, addr)
+
+    # -- leases ----------------------------------------------------------
+    def _start_leases(self) -> None:
+        if self.size <= 1:
+            return  # a solo world has nobody to suspect
+        self._lease_stop = threading.Event()
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name=f"lease[e{self.epoch}]",
+            daemon=True,
+            args=(self._lease_stop, self._client(), self._ens(),
+                  self.size, self.index))
+        self._lease_thread.start()
+
+    def _stop_leases(self) -> None:
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(
+                timeout=self.config.probe_timeout_s * 2 + 1)
+            self._lease_thread = None
+
+    def _lease_loop(self, stop: threading.Event, client, ens: str,
+                    size: int, index: int) -> None:
+        """Publish this host's lease counter; probe every peer's. A
+        counter unchanged past lease_timeout_s is a missed lease. Runs
+        against one epoch's client and dies with it (reconfiguration
+        stops it first)."""
+        seq = 0
+        t_start = time.monotonic()
+        last_change: Dict[int, Tuple[Optional[str], float]] = {
+            i: (None, t_start) for i in range(size) if i != index}
+        probe_ms = int(self.config.probe_timeout_s * 1000)
+        while not stop.wait(self.config.lease_interval_s):
+            try:
+                client.key_value_set(f"{ens}/lease/{index}", str(seq),
+                                     allow_overwrite=True)
+            except Exception as e:
+                self._mark_coordinator_lost(e)
+                return
+            seq += 1
+            now = time.monotonic()
+            for i in list(last_change):
+                if stop.is_set():
+                    return
+                try:
+                    value = client.blocking_key_value_get(
+                        f"{ens}/lease/{i}", probe_ms)
+                except Exception as e:
+                    if "DEADLINE_EXCEEDED" not in str(e):
+                        self._mark_coordinator_lost(e)
+                        return
+                    value = None  # never posted yet: stale since epoch
+                prev, since = last_change[i]
+                if value is not None and value != prev:
+                    last_change[i] = (value, now)
+                elif now - since > self.config.lease_timeout_s:
+                    with self._lock:
+                        if i not in self._suspects:
+                            self._suspects.add(i)
+                            print(f"[elastic] epoch {self.epoch}: member "
+                                  f"{i} missed its lease (no heartbeat "
+                                  f"for {now - since:.1f}s > "
+                                  f"{self.config.lease_timeout_s:.0f}s)",
+                                  flush=True)
+
+    def _mark_coordinator_lost(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._coordinator_lost is None:
+                self._coordinator_lost = \
+                    f"{type(exc).__name__}: {str(exc)[:120]}"
+        print(f"[elastic] epoch {self.epoch}: KV store unreachable "
+              f"({self._coordinator_lost})", flush=True)

@@ -123,6 +123,50 @@ def verify_state(state, template, sample_every: int = _SAMPLE_EVERY) -> None:
                 f"values")
 
 
+def prune_steps_above(directory: str, step: int,
+                      verbose: bool = True) -> "list[int]":
+    """Delete every committed step NEWER than ``step`` — the elastic
+    reconfiguration's zombie-flush guard (resilience.membership).
+
+    A shrink abandons the old world's in-flight async flush without
+    waiting (its commit barriers against a dead host). That flush
+    thread may still COMMIT its step after the survivors have agreed to
+    resume from an older one — leaving a directory whose newest step
+    the new world never agreed on, which a later restore would happily
+    land on (divergence) and whose dir would swallow the re-save when
+    training reaches that number again (orbax's silent no-op on
+    existing step dirs). The new epoch's writer calls this right after
+    ``agree_step`` settles the resume point.
+
+    Deliberately bypasses the checkpoint manager: this runs between
+    membership epochs, when per-step manager deletes would barrier
+    across hosts that may hold DIFFERENT step lists (the dead host's
+    flush landed on one disk only) — a deadlock, not a cleanup. Pure
+    filesystem listing + rmtree of committed step dirs and their
+    stream sidecars, safe because the caller is the directory's only
+    writer and its own flush machinery was already abandoned/reset.
+    """
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    pruned = []
+    for name in sorted(names):
+        if not name.isdigit() or int(name) <= step:
+            continue
+        if not osp.isdir(osp.join(directory, name)):
+            continue
+        shutil.rmtree(osp.join(directory, name), ignore_errors=True)
+        delete_position(directory, int(name))
+        pruned.append(int(name))
+    if pruned and verbose:
+        print(f"[resilience] pruned step(s) {pruned} above the agreed "
+              f"resume step {step} under {directory} (zombie flush from "
+              f"a previous membership epoch — never part of the agreed "
+              f"history)", flush=True)
+    return pruned
+
+
 def restore_verified(
     directory: str,
     template: TrainState,

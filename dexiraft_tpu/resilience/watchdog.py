@@ -71,6 +71,16 @@ class HangWatchdog:
         self.ewma_s: Optional[float] = None
         self.fired = False
         self.straggler_warnings = 0
+        # elastic handoff (resilience.membership): when set, the FIRST
+        # stall verdict is handed to this callback instead of exiting.
+        # on_stall(step, region) returns a grace window in seconds —
+        # the region is re-armed once so the membership runtime can run
+        # ONE reconfiguration attempt (the stuck thread unblocks via
+        # its own op timeout, sees the verdict, and reconfigures) — or
+        # None/0 to decline. A second stall (grace exhausted, or the
+        # reconfiguration itself wedged) exits 98 as before.
+        self.on_stall: Optional[Callable[[int, str], Optional[float]]] = None
+        self._stall_handed = False
         self._lock = OrderedLock("resilience.watchdog.armed")
         self._armed: Optional[tuple] = None  # (step, region, t0, warned)
         self._stop = threading.Event()
@@ -143,6 +153,13 @@ class HangWatchdog:
                 self.ewma_s = (1 - a) * self.ewma_s + a * dt
         return dt
 
+    def reset_stall_handoff(self) -> None:
+        """Re-enable the one-shot elastic handoff after a COMPLETED
+        reconfiguration: the new epoch gets its own single attempt, while
+        a reconfiguration that never finished keeps the latch so the
+        second fire still exits."""
+        self._stall_handed = False
+
     # -- monitor -----------------------------------------------------------
     def check_once(self) -> Optional[str]:
         """One monitor poll (the thread's body; tests call it directly).
@@ -178,13 +195,47 @@ class HangWatchdog:
 
     def _fire(self, step: int, region: str, dt: float,
               limit: Optional[float] = None) -> None:
-        self.fired = True
         out = self._stream or sys.stderr
+        if self.on_stall is not None and not self._stall_handed:
+            # one elastic reconfiguration attempt before the exit: the
+            # verdict (armed region named, so the membership runtime
+            # knows WHICH collective wedged) goes to on_stall, and the
+            # granted grace re-arms the region exactly once. If the
+            # reconfiguration itself stalls, the next fire exits.
+            self._stall_handed = True
+            try:
+                grace = self.on_stall(step, region)
+            except Exception as e:
+                print(f"[watchdog:{self.label}] on_stall handler failed "
+                      f"({type(e).__name__}: {e}); falling through to "
+                      f"exit", file=out, flush=True)
+                grace = None
+            if grace:
+                print(f"[watchdog:{self.label}] STALL: {region} at step "
+                      f"{step} has made no progress for {dt:.1f}s — "
+                      f"verdict handed to the elastic membership runtime "
+                      f"({grace:.0f}s grace for one reconfiguration "
+                      f"attempt before exit {STALL_EXIT_CODE})",
+                      file=out, flush=True)
+                with self._lock:
+                    armed = self._armed
+                    if armed is not None:
+                        s, r, _, warned, _ = armed
+                        # re-arm from now as a sanctioned slow region
+                        # sized so the grace window elapses before the
+                        # next fire (timeout_s * slow_region_factor)
+                        self._armed = (s, r, self._clock() + max(
+                            0.0, grace - self.timeout_s
+                            * self.slow_region_factor), warned, False)
+                return
+        self.fired = True
         print(f"[watchdog:{self.label}] STALL: {region} at step {step} "
               f"has made no progress for {dt:.1f}s "
               f"(timeout {limit if limit is not None else self.timeout_s:.0f}s)"
               f" — dumping live stacks "
-              f"and exiting {STALL_EXIT_CODE} instead of hanging the pod",
+              f"and exiting {STALL_EXIT_CODE} instead of hanging the pod"
+              f" (a host lost mid-collective? --elastic lets the "
+              f"membership runtime shrink and continue instead)",
               file=out, flush=True)
         try:
             faulthandler.dump_traceback(file=out)

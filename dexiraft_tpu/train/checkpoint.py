@@ -131,6 +131,65 @@ def close_managers() -> None:
     _MANAGERS.clear()
 
 
+def reset_managers(abandon_pending: bool = False) -> None:
+    """Drop every cached manager WITHOUT reusing it in the next world
+    (resilience.membership, around an elastic reconfiguration).
+
+    A cached CheckpointManager is bound to the world it was built in:
+    its barrier decisions key off jax.process_count() at construction,
+    and orbax's cross-host barrier names come from module-global
+    counters that advance per operation. Carrying either across a
+    membership epoch desynchronizes incumbents from fresh joiners (one
+    side skips a barrier the other waits on — a deadlock, not an
+    error). So at every reconfiguration: close or abandon the cached
+    managers, then rewind orbax's barrier-name counters to match a
+    fresh process.
+
+    abandon_pending=True is the shrink path (a peer is DEAD, so any
+    barrier — mgr.close, even waiting politely on an in-flight flush
+    whose commit barriers against the dead host — can hang): pending
+    flushes are dropped unwaited, the executor is discarded with its
+    queue, and managers are unreferenced without close(). The flush
+    thread may still be blocked inside orbax; it is a daemon-grade
+    zombie whose step, if it ever commits, is pruned by the membership
+    runtime (verify.prune_steps_above) before the new epoch's first
+    save.
+    """
+    global _EXECUTOR
+    if not abandon_pending:
+        close_managers()
+        _reset_orbax_barrier_counters()
+        return
+    with _LOCK:
+        _PENDING.clear()
+    if _EXECUTOR is not None:
+        _EXECUTOR.shutdown(wait=False, cancel_futures=True)
+        _EXECUTOR = None
+    _MANAGERS.clear()
+    _reset_orbax_barrier_counters()
+
+
+def _reset_orbax_barrier_counters() -> None:
+    """Rewind orbax's module-global barrier-name counters to zero.
+
+    orbax.checkpoint.multihost.counters derives cross-host barrier key
+    suffixes from itertools.count() module globals. After an elastic
+    grow, an incumbent's counters have advanced past a fresh joiner's
+    zeros, so their barrier names never match and both sides hang.
+    Resetting every counter (on every member, incumbents and joiners
+    alike — the reconfiguration round is the synchronization point)
+    restores the alignment a fresh process pair would have."""
+    import itertools
+
+    try:
+        from orbax.checkpoint.multihost import counters as _counters
+    except Exception:
+        return
+    for name in dir(_counters):
+        if isinstance(getattr(_counters, name), itertools.count):
+            setattr(_counters, name, itertools.count())
+
+
 # --- typed-PRNG-key leaf handler -----------------------------------------
 
 def _is_typed_key(leaf: Any) -> bool:

@@ -225,6 +225,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "divergence verdicts coordinate on "
                         "--guard_every; single-process runs never "
                         "issue a collective)")
+    p.add_argument("--coord_timeout_s", type=float, default=600.0,
+                   help="consensus-op timeout: a peer posting no value "
+                        "for this long raises the one-line "
+                        "CoordinatorTimeout naming the peer and round "
+                        "instead of waiting forever (under --elastic "
+                        "this is also how a stuck survivor unblocks "
+                        "into reconfiguration — set it to seconds, "
+                        "not minutes)")
+    # elastic pod membership (docs/resilience.md "Elastic membership"):
+    # a lost host becomes a shrink-and-continue reconfiguration inside
+    # the SAME process — new membership epoch, smaller mesh, agreed-step
+    # restore, re-sliced data stream — instead of an exit-98 pod
+    # restart; replacement hosts join at the next checkpoint boundary
+    p.add_argument("--elastic", action="store_true",
+                   help="survive host loss by reconfiguring the pod "
+                        "membership (resilience.membership) instead of "
+                        "exiting: needs JAX_COORDINATOR_ADDRESS (+ "
+                        "JAX_NUM_PROCESSES/JAX_PROCESS_ID on pods; a "
+                        "solo incumbent may omit them), and exits 98 "
+                        "only when recovery is impossible (--min_hosts, "
+                        "rank-0 loss, reconfiguration timeout)")
+    p.add_argument("--min_hosts", type=int, default=1,
+                   help="elastic: refuse to shrink below this many "
+                        "hosts — a deeper cascade falls back to the "
+                        "exit-98 restart contract")
+    p.add_argument("--join", default=None, metavar="NAME",
+                   help="enter a running --elastic job as a replacement "
+                        "host under this name: posts a join intent on "
+                        "the membership board and is absorbed at the "
+                        "incumbents' next checkpoint boundary "
+                        "(implies --elastic and --resume)")
     # runtime guard mode (analysis/guards.py, docs/static_analysis.md):
     # the dynamic half of the jaxlint story. Off, drift still surfaces
     # as a one-line warning on the guard cadence.
@@ -315,7 +346,25 @@ def _make_validators(cfg: RAFTConfig, names, variables_fn):
     return run
 
 
-def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
+class _GrowBoundary(Exception):
+    """Internal control flow: a checkpoint boundary collectively agreed
+    that join intents are pending. The segment loop (_elastic_main)
+    absorbs them and re-enters train() in the grown world."""
+
+    def __init__(self, step: int):
+        self.step = step
+        super().__init__(f"grow at checkpoint boundary (step {step})")
+
+
+def train(cfg: RAFTConfig, tc: TrainConfig, args, elastic=None,
+          prune_above_restore: bool = False) -> None:
+    """One training segment. Non-elastic runs: the whole job. Under
+    --elastic: one membership epoch — a ReconfigureNeeded /
+    CoordinatorTimeout / _GrowBoundary raise unwinds this function
+    (closing loader, watchdog, guards on the way), the segment loop
+    reconfigures the world, and re-enters with resume semantics; every
+    world-derived object (mesh, loader slice, coordinator namespace,
+    jitted step) is rebuilt here against the new world."""
     import os.path as osp
 
     from dexiraft_tpu.data.datasets import fetch_dataset
@@ -429,8 +478,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     # process, one tiny allgather per decision on a multi-host mesh —
     # every failure verdict below (divergence, preemption, resume step)
     # is the SAME on every host, so no host ever rolls back or exits
-    # alone into a hung collective
-    coord = Coordinator()
+    # alone into a hung collective. Elastic worlds get a per-epoch
+    # namespace (stale rounds from a previous epoch can never collide)
+    # and the CLI's consensus timeout, which doubles as the unblock
+    # path into reconfiguration when a peer dies mid-exchange.
+    coord = (Coordinator(namespace=elastic.coord_namespace(),
+                         timeout_s=args.coord_timeout_s)
+             if elastic is not None
+             else Coordinator(timeout_s=args.coord_timeout_s))
     # hang watchdog (resilience.watchdog): created and started BEFORE
     # the first consensus exchange below, so a peer dying during the
     # startup restore is bounded and stack-dumped like any other hang.
@@ -438,6 +493,11 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
     wd = HangWatchdog(args.stall_timeout,
                       straggler_factor=args.straggler_factor,
                       label=f"train[{tc.name}]").start()
+    if elastic is not None:
+        # first stall verdict is handed to the membership runtime (one
+        # reconfiguration attempt under a grace window) before the
+        # watchdog's exit-98 fallback fires
+        wd.on_stall = elastic.notify_stall
     # one throwaway consensus exchange FIRST: coordination-service
     # breakage surfaces here, loudly, before any real verdict depends
     # on it (no-op single-process)
@@ -495,6 +555,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
             sys.exit(f"[resume] {e}")
         if pos is not None:
             stream_pos = pos
+        if prune_above_restore:
+            # elastic re-entry after a reconfiguration: a zombie flush
+            # from the lost world may still commit a step ABOVE this
+            # agreement; later restores must never land on it, and the
+            # new segment's own saves must not no-op onto stale dirs
+            from dexiraft_tpu.resilience import prune_steps_above
+
+            prune_steps_above(ckpt_dir, last_saved)
         print(f"Resumed full state at step "
               f"{int(jax.device_get(state.step))} "
               f"(data stream: epoch {stream_pos.epoch}, "
@@ -651,6 +719,12 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
             # stall_timeout or deaden the straggler EWMA. The watchdog
             # arms once the steady-state contract does (watch warmup).
             for batch in batches:
+                if elastic is not None:
+                    # membership verdict check: lock-and-read local
+                    # state (the RPCs live on the lease thread), raising
+                    # ReconfigureNeeded/ElasticFallback out of this
+                    # segment at a step boundary
+                    elastic.poll()
                 # range-based (not equality) so resumed runs landing inside
                 # the window still profile, and stop only pairs with a start
                 if (not prof_active and prof_start <= total_steps < prof_stop):
@@ -824,6 +898,14 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
                     wd.arm(total_steps, "checkpoint+validation",
                            steady=False)
                     save_with_position(total_steps)
+                    # grow-at-checkpoint: absorption is a COLLECTIVE
+                    # decision (any_flag), so every incumbent leaves
+                    # this segment at the same boundary; the segment
+                    # loop commits the in-flight save, absorbs the
+                    # joiners, and re-enters in the larger world
+                    if elastic is not None and coord.any_flag(
+                            bool(elastic.pending_joins())):
+                        raise _GrowBoundary(total_steps)
                     # validation is a sanctioned window: its eval steps
                     # compile once per set (absorbed by mark_warm below)
                     # and its dataset readers are host-side by design
@@ -938,17 +1020,109 @@ def train(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
         print(f"Done: {total_steps} steps -> {ckpt_dir}")
 
 
+def _elastic_main(cfg: RAFTConfig, tc: TrainConfig, args) -> None:
+    """The elastic segment loop: each train() call is one membership
+    epoch; membership verdicts unwind it, the world is reconfigured
+    (shrink on loss, grow at checkpoint boundaries), and the next
+    segment re-enters with resume semantics in the new world. Only the
+    cases elastic cannot absorb — rank-0 loss, a cascade below
+    --min_hosts, a failed agreement — exit 98, the watchdog's
+    restart-the-pod contract."""
+    import os
+    import os.path as osp
+
+    from dexiraft_tpu.data.loader import world_compatible
+    from dexiraft_tpu.parallel.distributed import _env_int
+    from dexiraft_tpu.resilience import (
+        CoordinatorTimeout,
+        ElasticConfig,
+        ElasticFallback,
+        MembershipRuntime,
+        ReconfigureNeeded,
+    )
+    from dexiraft_tpu.resilience.watchdog import STALL_EXIT_CODE
+    from dexiraft_tpu.train import checkpoint as ckpt
+
+    ckpt_dir = osp.join(args.output, tc.name)
+    ecfg = ElasticConfig(
+        # how peers dial THIS host (the coordination service binds here
+        # when this host becomes an epoch's rank 0)
+        host=os.environ.get("DEXIRAFT_ELASTIC_HOST", "127.0.0.1"),
+        # the one channel that exists before a joiner has KV access:
+        # the shared checkpoint filesystem
+        board_dir=osp.join(ckpt_dir, "membership"),
+        min_hosts=args.min_hosts,
+        global_batch=tc.batch_size,
+        # survivors may arrive at the agreement only after their own
+        # consensus op times out against the dead peer
+        reconfig_timeout_s=max(30.0, args.coord_timeout_s * 2),
+    )
+    mrt = MembershipRuntime(ecfg)
+    try:
+        if args.join:
+            mrt.join(args.join)
+            args.resume = True  # a joiner always enters via restore
+        else:
+            addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+            if addr is None:
+                # solo incumbent: a one-host elastic world whose whole
+                # point is absorbing joiners later
+                addr = "127.0.0.1:7639"
+                num, pid = 1, 0
+            else:
+                num = _env_int("JAX_NUM_PROCESSES")
+                pid = _env_int("JAX_PROCESS_ID")
+            mrt.bootstrap(addr, num, pid)
+        prune = False
+        while True:
+            reason = world_compatible(tc.batch_size, mrt.size)
+            if reason is not None:  # pre-checked by reconfigure; belt+braces
+                raise ElasticFallback(reason)
+            try:
+                train(cfg, tc, args, elastic=mrt,
+                      prune_above_restore=prune)
+                return
+            except (ReconfigureNeeded, CoordinatorTimeout) as verdict:
+                print(f"[elastic] segment ended at epoch {mrt.epoch}: "
+                      f"{verdict}", flush=True)
+                mrt.reconfigure(dead=getattr(verdict, "dead", None))
+                prune = True
+            except _GrowBoundary as g:
+                # commit the boundary's in-flight save before the
+                # graceful teardown, so the joiners restore it
+                ckpt.wait_pending(ckpt_dir)
+                print(f"[elastic] absorbing "
+                      f"{[j['name'] for j in mrt.pending_joins()]} at "
+                      f"step {g.step}", flush=True)
+                mrt.absorb_joins()
+                prune = False
+            args.resume = True  # every later segment enters via restore
+    except ElasticFallback as e:
+        print(f"[elastic] fallback to pod restart: {e}", flush=True)
+        raise SystemExit(STALL_EXIT_CODE)
+    finally:
+        mrt.close()
+
+
 def main(argv=None) -> None:
     from dexiraft_tpu.parallel.distributed import initialize
 
-    initialize()  # no-op single-process; multi-host via env vars
     args = build_parser().parse_args(argv)
     if args.coord_every < 1:
         sys.exit("train: --coord_every must be >= 1 (it is a step "
                  "modulus; there is no 'never poll' mode — preemption "
                  "broadcast is what keeps a multi-host mesh exiting "
                  "together)")
+    if args.coord_timeout_s <= 0:
+        sys.exit("train: --coord_timeout_s must be > 0 (a consensus op "
+                 "with no timeout hangs the pod on the first dead peer)")
     cfg, tc = resolve_configs(args)
+    if args.elastic or args.join:
+        # elastic owns runtime initialization (per membership epoch);
+        # the plain initialize() path must not claim the process first
+        _elastic_main(cfg, tc, args)
+        return
+    initialize()  # no-op single-process; multi-host via env vars
     train(cfg, tc, args)
 
 

@@ -39,15 +39,28 @@ Phases:
                      retry absorb the death), the breaker opens inside
                      the recovery bound, and the dead replica's
                      sessions remap (sticky misses, then warm again)
+  8 shrink-and-continue  the SAME kill as phase 6 but under --elastic
+                     (resilience.membership): the survivor re-forms a
+                     solo membership epoch, restores the agreed step,
+                     and FINISHES the run with exit 0 — its
+                     reconfiguration recovery_s is pinned into the
+                     record next to phase 6's exit-98 abort wall (the
+                     cost elastic replaces), and the child's lock-order
+                     runtime must report zero violations across the
+                     reconfiguration
 
 The last stdout line is a JSON record with per-phase recovery
 wall-times (`[chaos] record {...}` — RECORD_KEYS pins the schema), so
 recovery-latency regressions are visible run-over-run in the logs. The
 record also carries the lock-order runtime's verdict (analysis/locks):
-the kill-mid-flush and router-failover phases assert — and pin into
-their record entries — ZERO lock-order violations and ZERO deadlock
-cycles while their thread fabric was under fire, so the concurrency
-gate holds under the exact chaos it exists for, not just in unit tests.
+the kill-mid-flush, router-failover, and shrink-and-continue phases
+assert — and pin into their record entries — ZERO lock-order
+violations and ZERO deadlock cycles while their thread fabric was
+under fire, so the concurrency gate holds under the exact chaos it
+exists for, not just in unit tests. The shrink phase additionally
+records its elastic `recovery_s` next to the multihost-kill phase's
+exit-98 `abort_s` — the restart cost it replaces — and asserts it is
+cheaper.
 The smoke also runs `lint_gate.py --json` up front (the machine-
 readable contract, no stdout scraping) and pins the static gate's
 verdict alongside — one record answers both halves of the concurrency
@@ -72,15 +85,20 @@ import numpy as np  # noqa: E402
 
 # JSON-tail schema: per-phase {ok, wall_s} plus totals; the locks block
 # is the lock-order runtime's verdict (analysis/locks.py) — the
-# kill-mid-flush and router-failover phases additionally pin a
-# per-phase snapshot proving ZERO order violations / deadlock cycles
-# were observed while their thread fabric was under fire
+# kill-mid-flush, router-failover, and shrink-and-continue phases
+# additionally pin a per-phase snapshot proving ZERO order violations /
+# deadlock cycles were observed while their thread fabric was under
+# fire (the shrink phase's snapshot comes from the SURVIVOR CHILD —
+# the process that ran the lease thread + flush executor + watchdog
+# through a real reconfiguration)
 RECORD_KEYS = ("phases", "failures", "total_s", "locks", "lint_gate")
 # every phase entry carries at least these keys ...
 PHASE_KEYS = ("ok", "wall_s")
-# ... and the two concurrency-gate phases (kill-mid-flush,
-# router-failover) additionally merge this key — their per-phase
-# lock-order snapshot
+# ... and the concurrency-gate phases (kill-mid-flush,
+# router-failover, shrink-and-continue) additionally merge this key —
+# their per-phase lock-order snapshot; multihost-kill merges abort_s
+# and shrink-and-continue merges {recovery_s, exit98_abort_s}, the
+# before/after pair of the elastic-membership story
 PHASE_LOCKS_KEY = "locks"
 
 
@@ -282,7 +300,13 @@ def phase_kill_mid_flush(tmp: str) -> dict:
     return _locks_verdict("kill-mid-flush")
 
 
-def phase_multihost_kill(tmp: str) -> None:
+# phase 6 publishes its exit-98 abort wall here; phase 8 records its
+# elastic recovery next to it — the two numbers are the before/after of
+# the elastic-membership story and belong in the same record
+_EXIT98_BASELINE: dict = {}
+
+
+def phase_multihost_kill(tmp: str) -> dict:
     repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
     child = osp.join(repo, "tests", "multiproc_resilience_child.py")
     # the SAME pair orchestration the tier-1 multihost tests use (kill
@@ -333,6 +357,56 @@ def phase_multihost_kill(tmp: str) -> None:
           f"(rc {survivor_rc}) in {abort_s:.0f}s; resume pair agreed on "
           f"step {resumed[0]} and finished BIT-EXACT vs the "
           f"uninterrupted pair")
+    _EXIT98_BASELINE["abort_s"] = round(abort_s, 1)
+    return {"abort_s": round(abort_s, 1)}
+
+
+def phase_shrink_and_continue(tmp: str) -> dict:
+    """Phase 6's kill under --elastic: the survivor must CONTINUE (rc 0,
+    all 8 steps) through a membership reconfiguration instead of
+    aborting for an orchestrator restart. recovery_s (verdict-to-new-
+    world, from the survivor's membership event) lands in the record
+    next to phase 6's abort wall — and must beat it: elastic recovery
+    is only worth its complexity while it is cheaper than the exit-98
+    path it replaces, BEFORE even counting the restart's re-init and
+    re-compile that the baseline number does not include."""
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    child = osp.join(repo, "tests", "multiproc_resilience_child.py")
+    from tests._mp_common import spawn_child_pair
+
+    outs = [f"{tmp}/el{pid}.json" for pid in range(2)]
+    rcs, logs, wall = spawn_child_pair(
+        child, outs, f"{tmp}/mh_elastic",
+        extra=["--elastic", "--die_step", "3", "--die_host", "1",
+               "--num_steps", "8", "--stall_timeout", "25"],
+        timeout=240.0)
+    assert rcs == [0, 3], \
+        f"elastic pair rcs {rcs}:\n{logs[0][-2000:]}\n{logs[1][-800:]}"
+    surv = json.load(open(outs[0]))
+    shrinks = [e for e in surv["membership_events"]
+               if e["kind"] == "shrink"]
+    assert len(shrinks) == 1, surv["membership_events"]
+    assert shrinks[0]["members"] == [0]
+    recovery_s = shrinks[0]["recovery_s"]
+    assert 0 < recovery_s < 60, f"recovery_s {recovery_s}"
+    assert surv["final_epoch"] == {"epoch": 1, "size": 1, "index": 0}
+    assert "8" in surv["losses"], "survivor never finished the run"
+    # the child's lock-order runtime ran the lease thread + flush
+    # executor + watchdog fabric through the reconfiguration
+    assert surv["locks"]["order_violations"] == 0, surv["locks"]
+    assert surv["locks"]["cycles"] == 0, surv["locks"]
+    baseline = _EXIT98_BASELINE.get("abort_s")
+    if baseline is not None:
+        assert recovery_s < baseline, \
+            f"elastic recovery ({recovery_s:.1f}s) is not cheaper than " \
+            f"the exit-98 abort it replaces ({baseline:.1f}s)"
+    print(f"    host 1 killed at step 3 under --elastic -> survivor "
+          f"reconfigured to a solo epoch in {recovery_s:.2f}s and "
+          f"finished all 8 steps (rc 0); exit-98 baseline abort: "
+          f"{baseline}s; child locks clean")
+    return {"recovery_s": round(recovery_s, 2),
+            "exit98_abort_s": baseline,
+            "locks": dict(surv["locks"])}
 
 
 def phase_router_failover(tmp: str) -> dict:
@@ -492,6 +566,8 @@ def main() -> int:
             ("kill-mid-flush", lambda: phase_kill_mid_flush(tmp)),
             ("multihost-kill", lambda: phase_multihost_kill(tmp)),
             ("router-failover", lambda: phase_router_failover(tmp)),
+            ("shrink-and-continue",
+             lambda: phase_shrink_and_continue(tmp)),
         ]
         try:
             for name, fn in phases:

@@ -124,7 +124,58 @@ def spawn_child_pair(child_path, outs, ckpt_dir, extra=(),
     return [p.returncode for p in procs], logs, time.perf_counter() - t0
 
 
-def patch_orbax_kv_barriers() -> None:
+def free_port() -> int:
+    """An OS-assigned free TCP port for a coordination service."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_child(child_path, out, ckpt_dir, port, process_id, extra=()):
+    """Popen ONE child. The elastic scenarios need heterogeneous
+    worlds — a solo incumbent plus a later --join replacement, or a
+    parity-reference rerun — which the symmetric pair launcher cannot
+    express. Same CLI surface and XLA_FLAGS hygiene as
+    spawn_child_pair; reap with reap_children."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    return subprocess.Popen(
+        [sys.executable, str(child_path), "--port", str(port),
+         "--process_id", str(process_id), "--out", str(out),
+         "--ckpt_dir", str(ckpt_dir), *[str(a) for a in extra]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def reap_children(procs, timeout: float = 300.0):
+    """Collect launch_child processes: ([rc...], [log...], wall_s),
+    with the same never-raise/kill-on-timeout contract as
+    spawn_child_pair."""
+    import subprocess
+    import time
+
+    t0 = time.perf_counter()
+    logs = []
+    try:
+        for p in procs:
+            try:
+                logs.append(p.communicate(timeout=timeout)[0]
+                            .decode(errors="replace"))
+            except subprocess.TimeoutExpired:
+                logs.append("<killed: timed out>")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    return [p.returncode for p in procs], logs, time.perf_counter() - t0
+
+
+def patch_orbax_kv_barriers(cap_timeout_s=None) -> None:
     """Reroute orbax's process-sync onto its distributed-client barrier.
 
     orbax 0.7.0's ``sync_global_processes`` defaults to an XLA allgather
@@ -140,15 +191,31 @@ def patch_orbax_kv_barriers() -> None:
     shim is unnecessary; on the 2-process virtual CPU mesh it is the
     difference between exercising the real multiprocess checkpoint path
     and not testing it at all.
+
+    cap_timeout_s caps every barrier's timeout (elastic children pass a
+    few seconds): a checkpoint barrier against a DEAD peer then fails
+    fast instead of pinning the flush — and with it anything behind the
+    wait_pending barrier — for orbax's default 300 s, which would
+    swallow the whole elastic recovery budget. Healthy barriers are
+    unaffected: the elastic worlds rendezvous at consensus boundaries,
+    so real flush skew is milliseconds.
     """
     from orbax.checkpoint import multihost as omh_pkg
     from orbax.checkpoint.multihost import utils as omh
 
     def kv_sync(name, *, timeout=None, processes=None,
                 barrier_sync_fn=None):
+        from jax._src import distributed
+
+        if barrier_sync_fn is None and distributed.global_state.client \
+                is None:
+            return  # solo world (or mid-elastic-reconfig): nobody to sync
         fn = barrier_sync_fn or omh.get_barrier_sync_fn(
             processes=processes)
-        fn(key=name, timeout_ms=int((timeout or 300) * 1000))
+        timeout_s = timeout or 300
+        if cap_timeout_s is not None:
+            timeout_s = min(timeout_s, cap_timeout_s)
+        fn(key=name, timeout_ms=int(timeout_s * 1000))
 
     omh.sync_global_processes = kv_sync
     omh_pkg.sync_global_processes = kv_sync

@@ -34,8 +34,26 @@ Fault injection:
       the survivor must exit nonzero via watchdog/collective error,
       never hang.
 
+Elastic mode (--elastic): the same faults, a different contract — the
+survivor CONTINUES instead of exiting. The child then runs the full
+membership runtime (resilience.membership): heartbeat leases, epoch
+reconfiguration, dead-peer-safe runtime teardown/re-init, and a
+per-world MESH POLICY chosen so reconfiguration genuinely crosses mesh
+shapes on this backend: a pair keeps state replicated (the CPU backend
+has no cross-process XLA), a solo world shards the 80x80 elastic model
+fsdp=2 over its two virtual devices — so the shrink restore lands a
+replicated checkpoint on an fsdp template and the grow restore does
+the reverse (the PR 13 template-resharding mechanic, exercised across
+real world changes). --join NAME makes the child a replacement host:
+it posts a join intent on the FileBoard and enters the world at the
+epoch the incumbents announce. Alongside each step's loss the elastic
+child records the epoch_permutation slice its world assigns it
+(epoch/offset/ids), which is what pins the re-slice contract in the
+parent test.
+
 Any exception exits via os._exit(97): atexit would otherwise run the
 checkpoint barrier against a dead peer and hang the "no hang" test.
+ElasticFallback exits 98 — the watchdog's restart-the-pod contract.
 """
 
 from __future__ import annotations
@@ -45,6 +63,7 @@ import json
 import os
 import os.path as osp
 import sys
+import time
 import traceback
 
 sys.path.insert(0, osp.dirname(osp.dirname(osp.abspath(__file__))))
@@ -65,6 +84,15 @@ GLOBAL_BATCH = 8
 FEATURES = 16
 COLLECTIVE_ERROR_EXIT = 97
 
+# elastic-mode geometry: the matrix must clear layout's
+# FSDP_MIN_LEAF_SIZE (4096) so the solo world's fsdp=2 mesh actually
+# shards it, and the virtual dataset must give a few global batches per
+# epoch so the re-slice records cross an epoch boundary
+E_FEATURES = 80
+E_DATASET_N = 32
+E_SEED = 7
+E_BATCHES_PER_EPOCH = E_DATASET_N // GLOBAL_BATCH
+
 
 def global_batch(step: int):
     """Deterministic pure function of the GLOBAL step index — the
@@ -73,6 +101,255 @@ def global_batch(step: int):
     x = r.normal(size=(GLOBAL_BATCH, FEATURES)).astype(np.float32)
     y = r.normal(size=(GLOBAL_BATCH, FEATURES)).astype(np.float32)
     return x, y
+
+
+def elastic_batch(step: int):
+    """Elastic-mode batch: same purity contract, E_FEATURES-wide."""
+    r = np.random.default_rng(1700 + step)
+    x = r.normal(size=(GLOBAL_BATCH, E_FEATURES)).astype(np.float32)
+    y = r.normal(size=(GLOBAL_BATCH, E_FEATURES)).astype(np.float32)
+    return x, y
+
+
+def slice_record(pos, size: int, index: int) -> dict:
+    """The data slice THIS member would decode at stream position
+    ``pos`` in a ``size``-member world — the epoch_permutation re-slice
+    contract (data.loader), recorded per step so the parent test can
+    assert disjoint+exhaustive coverage across world changes."""
+    from dexiraft_tpu.data.loader import epoch_permutation
+
+    order = epoch_permutation(E_SEED, pos.epoch, E_DATASET_N)
+    lo = pos.offset * GLOBAL_BATCH
+    window = order[lo:lo + GLOBAL_BATCH]
+    local = GLOBAL_BATCH // size
+    mine = window[index * local:(index + 1) * local]
+    return {"epoch": int(pos.epoch), "offset": int(pos.offset),
+            "size": size, "ids": [int(i) for i in mine]}
+
+
+def run_elastic(args) -> None:
+    """The elastic-membership child: one member (or joiner) of an
+    epoch-numbered world. See module docstring for the mesh policy and
+    what each scenario proves."""
+    import optax
+
+    from tests._mp_common import patch_orbax_kv_barriers
+    from dexiraft_tpu.data.loader import world_compatible
+    from dexiraft_tpu.parallel import layout
+    from dexiraft_tpu.resilience import (
+        Coordinator,
+        CoordinatorTimeout,
+        ElasticConfig,
+        ElasticFallback,
+        HangWatchdog,
+        MembershipRuntime,
+        ReconfigureNeeded,
+        StreamPosition,
+        load_position,
+        prune_steps_above,
+        restore_verified,
+        save_position,
+    )
+    from dexiraft_tpu.train import checkpoint as ckpt
+    from dexiraft_tpu.train.state import TrainState
+
+    # cap the orbax barrier timeout well under the reconfiguration
+    # budget: a flush barrier against a dead peer must fail fast, or a
+    # wedged flush pins the next boundary's wait_pending for orbax's
+    # default 300 s and the membership verdict never gets control
+    patch_orbax_kv_barriers(cap_timeout_s=6.0)
+    cfg = ElasticConfig(
+        host="127.0.0.1",
+        board_dir=osp.join(args.ckpt_dir, "membership"),
+        min_hosts=args.min_hosts,
+        global_batch=GLOBAL_BATCH,
+        lease_interval_s=0.25,
+        lease_timeout_s=2.0,
+        probe_timeout_s=0.5,
+        reconfig_timeout_s=15.0,
+        join_poll_s=0.2,
+    )
+    mrt = MembershipRuntime(cfg)
+    if args.join:
+        info = mrt.join(args.join)
+    else:
+        info = mrt.bootstrap(f"127.0.0.1:{args.port}",
+                             args.num_processes, args.process_id)
+    orig_pid = args.process_id
+    tx = optax.sgd(0.05)
+
+    def build_world():
+        """Mesh + fresh template state + per-epoch Coordinator for the
+        CURRENT world (called after every epoch install)."""
+        mesh = (layout.make_train_mesh(GLOBAL_BATCH, fsdp=2)
+                if mrt.size == 1 else None)
+        w0 = np.asarray(
+            jax.random.normal(jax.random.PRNGKey(7),
+                              (E_FEATURES, E_FEATURES)), np.float32)
+        params = {"w": jnp.asarray(w0)}
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           batch_stats={}, opt_state=tx.init(params),
+                           rng=jax.random.PRNGKey(0))
+        if mesh is not None:
+            state = layout.shard_state(state, mesh)
+        coord = Coordinator(namespace=mrt.coord_namespace(),
+                            timeout_s=args.coord_timeout)
+        return mesh, state, coord
+
+    @jax.jit
+    def step_fn(state, x, y):
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state,
+                                       state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state), loss
+
+    mesh, state, coord = build_world()
+    wd = HangWatchdog(args.stall_timeout,
+                      label=f"mpchild{orig_pid}").start()
+    wd.on_stall = mrt.notify_stall
+    coord.warmup()
+
+    events = []
+    losses = {}
+    slices = {}
+    start = 0
+    pos = StreamPosition(0, 0)
+    last_saved = None
+
+    def agreed_restore(bound):
+        nonlocal state, start, pos, last_saved
+        state, start = coord.agree_step(
+            lambda b: restore_verified(args.ckpt_dir, state, step=b,
+                                       verbose=False, clean_debris=True),
+            bound)
+        pos = load_position(args.ckpt_dir, start) or StreamPosition(0, 0)
+        last_saved = start
+        return start
+
+    if args.resume or args.join:
+        bound = args.resume_bound if args.resume_bound >= 0 else None
+        agreed_restore(bound)
+        events.append({"resumed": start, "epoch": mrt.epoch})
+
+    step = start
+    while step < args.num_steps:
+        try:
+            step += 1
+            wd.arm(step)
+            mrt.poll()
+            x, y = elastic_batch(step)
+            state, loss = step_fn(state, jnp.asarray(x), jnp.asarray(y))
+            losses[str(step)] = float(jax.device_get(loss))
+            slices[str(step)] = slice_record(pos, mrt.size, mrt.index)
+            pos = pos.advance(1, E_BATCHES_PER_EPOCH)
+
+            if args.die_step is not None and step == args.die_step \
+                    and orig_pid == args.die_host:
+                # drain this host's own flush first: the commit barrier
+                # rendezvoused, so the survivor's copy of the last save
+                # is committed too — the parity assertion needs the
+                # agreed restore step to be deterministic, not a race
+                # between the flush threads and os._exit
+                ckpt.wait_pending(args.ckpt_dir)
+                print(f"[chaos] host {orig_pid} dying at step {step}",
+                      flush=True)
+                os._exit(3)
+
+            if args.save_every and step % args.save_every == 0:
+                ckpt.save_checkpoint(args.ckpt_dir, state, step=step,
+                                     block=False)
+                save_position(args.ckpt_dir, step, pos, seed=E_SEED)
+                last_saved = step
+                if args.wait_join_at == step:
+                    # test determinism only: absorb at THIS boundary,
+                    # so block until the joiner's intent is posted
+                    deadline = time.monotonic() + 120.0
+                    while not mrt.pending_joins() \
+                            and time.monotonic() < deadline:
+                        time.sleep(0.2)
+                # checkpoint boundary: absorb pending joiners — a
+                # collective decision, so every incumbent grows at the
+                # same boundary. Poll first: a suspect flagged while
+                # this step computed turns into the (better-attributed)
+                # ReconfigureNeeded instead of a CoordinatorTimeout.
+                mrt.poll()
+                if coord.any_flag(bool(mrt.pending_joins())):
+                    wd.arm(step, "grow-reconfigure", steady=False)
+                    ckpt.wait_pending(args.ckpt_dir)
+                    info = mrt.absorb_joins()
+                    mesh, state, coord = build_world()
+                    coord.warmup()
+                    agreed_restore(None)
+                    wd.reset_stall_handoff()
+                    step = start
+                    events.append({"grew_to": mrt.size,
+                                   "epoch": mrt.epoch,
+                                   "restored": start})
+            wd.disarm()
+        except (ReconfigureNeeded, CoordinatorTimeout) as verdict:
+            wd.disarm(feed_ewma=False)
+            wd.arm(step, "shrink-reconfigure", steady=False)
+            events.append({"verdict": type(verdict).__name__,
+                           "detail": str(verdict)[:200], "at_step": step})
+            info = mrt.reconfigure(dead=getattr(verdict, "dead", None))
+            reason = world_compatible(GLOBAL_BATCH, info.size)
+            if reason is not None:  # pre-checked by config; belt+braces
+                raise ElasticFallback(reason)
+            mesh, state, coord = build_world()
+            coord.warmup()
+            agreed_restore(None)
+            # a zombie flush from the old world must not leave steps
+            # above the agreement for a later restore to land on
+            prune_steps_above(args.ckpt_dir, start, verbose=False)
+            wd.reset_stall_handoff()
+            wd.disarm()
+            step = start
+            events.append({"reconfigured": mrt.epoch, "size": mrt.size,
+                           "restored": start,
+                           "recovery_s": mrt.events[-1]["recovery_s"]})
+
+    if args.save_every:
+        ckpt.wait_pending(args.ckpt_dir)
+    mrt.close()
+    wd.stop()
+    norm = float(np.sqrt(sum(
+        float(np.sum(np.asarray(jax.device_get(x)) ** 2))
+        for x in jax.tree.leaves(state.params))))
+    try:
+        saved = sorted(int(n) for n in os.listdir(args.ckpt_dir)
+                       if n.isdigit())
+    except OSError:
+        saved = []
+    from dexiraft_tpu.analysis import locks
+
+    lrec = locks.stats_record()
+    result = {
+        "process_id": orig_pid,
+        "mode": "elastic",
+        # THIS process ran the lease thread + flush executor + watchdog
+        # lock fabric through a real reconfiguration — its lock-order
+        # verdict is what the chaos-smoke shrink phase pins
+        "locks": {"order_violations": lrec["order_violations"],
+                  "cycles": lrec["cycles"]},
+        "losses": losses,
+        "slices": slices,
+        "events": events,
+        "membership_events": mrt.events,
+        "final_epoch": {"epoch": mrt.epoch, "size": mrt.size,
+                        "index": mrt.index},
+        "param_norm": norm,
+        "final_w": np.asarray(
+            jax.device_get(state.params["w"])).tolist(),
+        "saved_steps": saved,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+    print("child done", json.dumps(result)[:160], flush=True)
 
 
 def main() -> None:
@@ -90,7 +367,31 @@ def main() -> None:
     ap.add_argument("--die_step", type=int, default=None)
     ap.add_argument("--die_host", type=int, default=1)
     ap.add_argument("--stall_timeout", type=float, default=25.0)
+    ap.add_argument("--elastic", action="store_true")
+    ap.add_argument("--join", default=None,
+                    help="join an elastic world as a replacement host "
+                         "under this name (implies --elastic)")
+    ap.add_argument("--min_hosts", type=int, default=1)
+    ap.add_argument("--coord_timeout", type=float, default=6.0)
+    ap.add_argument("--resume_bound", type=int, default=-1,
+                    help="elastic resume: restore at or below this step")
+    ap.add_argument("--wait_join_at", type=int, default=None,
+                    help="elastic: at this save boundary, wait for a "
+                         "join intent before the absorb check")
     args = ap.parse_args()
+
+    if args.elastic or args.join:
+        from dexiraft_tpu.resilience import ElasticFallback
+        from dexiraft_tpu.resilience.watchdog import STALL_EXIT_CODE
+
+        try:
+            run_elastic(args)
+        except ElasticFallback as e:
+            # the cases elastic cannot absorb keep the watchdog's
+            # exit-98-and-restart contract
+            print(f"[elastic] fallback to pod restart: {e}", flush=True)
+            os._exit(STALL_EXIT_CODE)
+        return
 
     from dexiraft_tpu.parallel.distributed import initialize
 

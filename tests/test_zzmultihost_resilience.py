@@ -3,9 +3,9 @@ consensus + watchdog + async-checkpoint story single-process tests
 cannot cover (named test_zz* to sort after the seed suite per the
 tier-1 budget convention).
 
-Three scenarios against tests/multiproc_resilience_child.py (which runs
+Five scenarios against tests/multiproc_resilience_child.py (which runs
 the same resilience primitives train_cli wires — coord, watchdog,
-async checkpoint, verified agreed restore):
+async checkpoint, verified agreed restore, elastic membership):
 
   * one-host poison: a verdict LOCAL to host 0 produces the SAME
     rollback step on BOTH hosts (consensus, not luck — the loss is
@@ -16,16 +16,30 @@ async checkpoint, verified agreed restore):
   * coordinated resume: after the kill, a --resume pair agrees on one
     restored step and finishes with parameters BIT-EXACT equal to an
     uninterrupted reference run.
+  * elastic shrink-and-continue: the same kill under --elastic, but the
+    survivor CONTINUES — missed lease -> new membership epoch, solo
+    mesh re-form, agreed-step restore, re-sliced data — and its
+    post-shrink loss sequence is pinned bit-exact against a fresh solo
+    run restored from the same agreed step.
+  * elastic grow-at-checkpoint: a replacement host posts a join intent
+    and is absorbed at the next checkpoint boundary; incumbent and
+    joiner finish bit-identical with disjoint+exhaustive data slices.
 """
 
 from __future__ import annotations
 
 import json
 import os.path as osp
+import time
 
 import pytest
 
-from tests._mp_common import spawn_child_pair
+from tests._mp_common import (
+    free_port,
+    launch_child,
+    reap_children,
+    spawn_child_pair,
+)
 
 _CHILD = osp.join(osp.dirname(osp.abspath(__file__)),
                   "multiproc_resilience_child.py")
@@ -119,3 +133,100 @@ def test_resume_after_kill_is_bit_exact(kill_and_reference, tmp_path):
     assert results[0]["final_w"] == ref[0]["final_w"]
     assert results[1]["final_w"] == ref[1]["final_w"]
     assert results[0]["final_w"] == results[1]["final_w"]
+
+
+def test_elastic_shrink_and_continue(tmp_path):
+    """Host 1 dies at step 3 under --elastic: host 0 must detect the
+    missed lease, reconfigure into a solo epoch-1 world (smaller mesh,
+    agreed-step restore, re-sliced stream), and FINISH the run with
+    exit 0 — the elastic counterpart of the kill-one-host abort."""
+    outs = [tmp_path / f"e{i}.json" for i in range(2)]
+    ck = tmp_path / "ck"
+    rcs, logs, wall = _spawn_pair(
+        outs, ck,
+        extra=["--elastic", "--die_step", "3", "--die_host", "1",
+               "--num_steps", "8", "--stall_timeout", "25"],
+        timeout=180.0)
+    assert rcs == [0, 3], f"shrink pair:\n{logs[0][-2500:]}\n" \
+                          f"{logs[1][-1500:]}"
+    surv = json.loads(outs[0].read_text())
+    shrinks = [e for e in surv["membership_events"]
+               if e["kind"] == "shrink"]
+    assert len(shrinks) == 1, surv["membership_events"]
+    assert shrinks[0]["members"] == [0]
+    assert 0 < shrinks[0]["recovery_s"] < 60
+    assert surv["final_epoch"] == {"epoch": 1, "size": 1, "index": 0}
+    rec = next(e for e in surv["events"] if "reconfigured" in e)
+    # host 1 drained its step-2 flush before dying, so the agreed
+    # restore step is exactly the last committed boundary
+    assert rec["restored"] == 2
+    # the solo world finished the remaining steps AND kept saving
+    assert set(surv["saved_steps"]) >= {2, 4, 6, 8}
+    # post-shrink the solo member owns every sample of each window
+    assert surv["slices"]["8"]["size"] == 1
+    assert len(surv["slices"]["8"]["ids"]) == 8
+
+    # parity pin: a FRESH solo elastic run restoring the same agreed
+    # step from the same directory (replicated pair checkpoint landing
+    # on the solo world's fsdp=2 template — the cross-mesh restore)
+    # must reproduce the survivor's post-shrink losses bit-exactly
+    ref_out = tmp_path / "ref.json"
+    proc = launch_child(
+        _CHILD, ref_out, ck, free_port(), 0,
+        extra=["--num_processes", "1", "--elastic", "--resume",
+               "--resume_bound", str(rec["restored"]),
+               "--save_every", "0", "--num_steps", "8"])
+    (rc,), (log,), _ = reap_children([proc], timeout=120.0)
+    assert rc == 0, log[-2500:]
+    ref = json.loads(ref_out.read_text())
+    assert ref["events"][0]["resumed"] == rec["restored"]
+    for s in range(rec["restored"] + 1, 9):
+        assert ref["losses"][str(s)] == surv["losses"][str(s)], \
+            f"post-shrink loss diverged at step {s}"
+    assert ref["param_norm"] == surv["param_norm"]
+
+
+def test_elastic_grow_at_checkpoint(tmp_path):
+    """A replacement host (--join) posts its intent on the FileBoard;
+    the solo incumbent absorbs it at the next checkpoint boundary into
+    an epoch-1 pair world. Both members restore the same step and must
+    finish bit-identical with disjoint+exhaustive data slices."""
+    ck = tmp_path / "ck"
+    port = free_port()
+    inc = launch_child(
+        _CHILD, tmp_path / "inc.json", ck, port, 0,
+        extra=["--num_processes", "1", "--elastic",
+               "--wait_join_at", "2", "--num_steps", "8"])
+    time.sleep(1.5)
+    jon = launch_child(
+        _CHILD, tmp_path / "jon.json", ck, port, 1,
+        extra=["--num_processes", "1", "--join", "w1",
+               "--num_steps", "8"])
+    rcs, logs, _ = reap_children([inc, jon], timeout=180.0)
+    assert rcs == [0, 0], f"grow pair:\n{logs[0][-2500:]}\n" \
+                          f"{logs[1][-2500:]}"
+    a = json.loads((tmp_path / "inc.json").read_text())
+    b = json.loads((tmp_path / "jon.json").read_text())
+    grows = [e for e in a["membership_events"] if e["kind"] == "grow"]
+    assert len(grows) == 1, a["membership_events"]
+    assert grows[0]["members"] == [0, 1]
+    assert grows[0]["join_ranks"] == {"w1": 1}
+    assert a["final_epoch"]["size"] == 2
+    assert b["final_epoch"] == {"epoch": 1, "size": 2, "index": 1}
+    # the joiner entered at the announced epoch and restored the same
+    # boundary the incumbents agreed (the solo fsdp=2 checkpoint
+    # landing on the pair's replicated template — the reverse
+    # cross-mesh restore)
+    assert b["events"][0] == {"resumed": 2, "epoch": 1}
+    for s in range(3, 9):
+        assert a["losses"][str(s)] == b["losses"][str(s)], \
+            f"post-grow loss diverged at step {s}"
+    assert a["final_w"] == b["final_w"]
+    # post-grow re-slice contract: each window split disjointly and
+    # exhaustively between the two members
+    for s in range(3, 9):
+        sa, sb = a["slices"][str(s)], b["slices"][str(s)]
+        assert sa["size"] == sb["size"] == 2
+        assert (sa["epoch"], sa["offset"]) == (sb["epoch"], sb["offset"])
+        assert not set(sa["ids"]) & set(sb["ids"])
+        assert len(sa["ids"]) + len(sb["ids"]) == 8

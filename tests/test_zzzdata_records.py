@@ -280,6 +280,63 @@ class TestGlobalShuffleContract:
                                   batches[1]["image1"])
 
 
+class TestElasticResliceContract:
+    """The contract elastic membership (resilience.membership) leans
+    on: a stream position (epoch, offset) addresses GLOBAL batches, so
+    it is host-count-invariant — a world that shrinks or grows re-
+    slices the same permutation windows at the new size instead of
+    deriving a new sample order. Pure numpy pins, no loader spin-up."""
+
+    SEED, GB, N = 7, 8, 32
+
+    def _window(self, epoch: int, offset: int):
+        order = epoch_permutation(self.SEED, epoch, self.N)
+        return order[offset * self.GB:(offset + 1) * self.GB]
+
+    def _slices(self, window, k: int):
+        local = len(window) // k
+        return [window[i * local:(i + 1) * local] for i in range(k)]
+
+    def test_disjoint_exhaustive_at_every_host_count(self):
+        for epoch in (0, 1):
+            for off in range(self.N // self.GB):
+                window = self._window(epoch, off)
+                for k in (1, 2, 4, 8):
+                    parts = self._slices(window, k)
+                    flat = np.concatenate(parts)
+                    # disjoint, exhaustive, and rank-ordered: the
+                    # concatenation of per-rank slices IS the window
+                    assert len(flat) == self.GB == len(np.unique(flat))
+                    assert flat.tolist() == window.tolist()
+
+    def test_world_change_replays_from_boundary_skips_nothing(self):
+        """Shrink semantics: the old 2-host world consumed offsets 0-1
+        of epoch 0 and the agreed checkpoint restores (epoch 0,
+        offset 2). The new world — at ANY size — replays exactly the
+        windows at offsets >= 2: no sample of the un-consumed tail is
+        skipped, no already-consumed sample reappears in this epoch."""
+        consumed = set(np.concatenate(
+            [self._window(0, off) for off in (0, 1)]).tolist())
+        tail = [self._window(0, off) for off in (2, 3)]
+        for k in (1, 2, 4):
+            replayed = [np.concatenate(self._slices(w, k)) for w in tail]
+            # same global windows, independent of the new host count
+            assert [r.tolist() for r in replayed] == \
+                [w.tolist() for w in tail]
+        tail_flat = set(np.concatenate(tail).tolist())
+        assert not tail_flat & consumed
+        assert tail_flat | consumed == set(range(self.N))
+
+    def test_world_compatible_guard(self):
+        from dexiraft_tpu.data.loader import world_compatible
+
+        assert world_compatible(8, 1) is None
+        assert world_compatible(8, 2) is None
+        assert world_compatible(8, 8) is None
+        assert "divide" in world_compatible(8, 3)
+        assert "positive" in world_compatible(8, 0)
+
+
 class TestLoaderKindSidecar:
     def test_mismatch_refused_with_actionable_error(self, tmp_path):
         save_position(str(tmp_path), 10, StreamPosition(2, 5), seed=1,
