@@ -112,8 +112,10 @@ def _load_rule_module(filename: str, modname: str):
 
 _shardlint = _load_rule_module("shardlint.py", "_shardlint")
 _threadlint = _load_rule_module("threadlint.py", "_threadlint")
+_distlint = _load_rule_module("distlint.py", "_distlint")
 RULES.update(_shardlint.RULES)
 RULES.update(_threadlint.RULES)
+RULES.update(_distlint.RULES)
 
 # dotted names that mean "jax.jit" after alias resolution
 _JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit",
@@ -343,6 +345,7 @@ class _Linter:
         self._rule_jl007(mod.tree)
         _shardlint.run_rules(self)   # JL010+ sharding-contract rules
         _threadlint.run_rules(self)  # JL020+ lock-discipline rules
+        _distlint.run_rules(self)    # JL030+ collective-divergence rules
         rel = mod.path.replace(os.sep, "/")
         if (rel.startswith(("dexiraft_tpu/train/", "dexiraft_tpu/eval/",
                             "dexiraft_tpu/serve/"))

@@ -72,6 +72,7 @@ LOCK_ORDER: Tuple[str, ...] = (
     "resilience.watchdog.armed", # HangWatchdog._lock: armed-region tuple
     "train.checkpoint.pending",  # checkpoint._LOCK: pending-flush registry
     "data.loader.pool",          # _PoolManager._lock: decode-pool generation
+    "resilience.trace.ring",     # CollectiveTrace._lock: flight-recorder ring
 )
 
 

@@ -97,6 +97,7 @@ LOCK_ORDER: Tuple[str, ...] = (
     "resilience.watchdog.armed",
     "train.checkpoint.pending",
     "data.loader.pool",
+    "resilience.trace.ring",
 )
 _RANK = {name: i for i, name in enumerate(LOCK_ORDER)}
 

@@ -224,8 +224,10 @@ def _train_epochs(args, dataset, guard, wd, step, ckpt_io, rng, n,
                 ids = order[:args.batch_size]
             if epoch or b:
                 # never armed over the first step: it contains the XLA
-                # compile, which a step-sized timeout would misread
-                wd.arm(epoch * steps_per_epoch + b + 1)
+                # compile, which a step-sized timeout would misread.
+                # Released across the frame boundary: main()'s
+                # `finally: wd.stop()` retires the arm on any unwind
+                wd.arm(epoch * steps_per_epoch + b + 1)  # jaxlint: disable=JL034 caller's finally stops it
             samples = [dataset.sample(int(i), np.random.default_rng(
                 (args.seed, epoch, int(i)))) for i in ids]
             images = np.stack([s["images"] for s in samples])

@@ -135,6 +135,15 @@ def elastic_initialize(coordinator_address: str, num_processes: int,
     st.preemption_sync_manager = (
         xla_extension.create_preemption_sync_manager())
     st.preemption_sync_manager.initialize(client)
+    # flight-recorder stamp: the connect above is itself a collective
+    # rendezvous (every member of the new world must dial in), so the
+    # (addr, size) digest is identical across the world
+    from dexiraft_tpu.analysis import collective_trace
+
+    collective_trace.record(
+        "dexiraft/elastic", "elastic_initialize",
+        digest=collective_trace.args_digest(coordinator_address,
+                                            num_processes))
 
 
 def elastic_teardown(graceful: bool = True) -> None:
@@ -153,6 +162,11 @@ def elastic_teardown(graceful: bool = True) -> None:
 
     from jax._src import distributed
 
+    from dexiraft_tpu.analysis import collective_trace
+
+    collective_trace.record(
+        "dexiraft/elastic", "elastic_teardown",
+        digest=collective_trace.args_digest(bool(graceful)))
     st = distributed.global_state
     client, service = st.client, st.service
     st.client = None

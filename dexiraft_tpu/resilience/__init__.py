@@ -38,6 +38,15 @@ Pod-grade additions (multi-host failure handling):
     warnings ride the same timer. Under --elastic the first stall
     verdict is handed to the membership runtime (one reconfiguration
     attempt) before the exit-98 fallback.
+  * analysis.collective_trace (re-exported: CollectiveDivergence) —
+    the collective flight recorder: every consensus round, membership
+    epoch, and checkpoint barrier is stamped (namespace, round, op,
+    digest) into a bounded per-host ring, and the Coordinator's in-band
+    lockstep check raises CollectiveDivergence naming the FIRST
+    divergent (host, round, op) the moment two hosts' sequences split —
+    a one-line diagnosis in seconds instead of a CoordinatorTimeout
+    after the full window. The watchdog dumps the ring's tail next to
+    its faulthandler stacks; distlint (JL030+) is the static half.
   * membership.MembershipRuntime — elastic pod membership: epoch-
     numbered worlds over the KV store with per-host heartbeat leases.
     A lost host becomes a shrink-and-continue reconfiguration (new
@@ -52,6 +61,7 @@ decode-pool rebuild) lives in data.loader — PipelineStats is re-exported
 here for the one-stop import.
 """
 
+from dexiraft_tpu.analysis.collective_trace import CollectiveDivergence
 from dexiraft_tpu.data.loader import PipelineStats
 from dexiraft_tpu.resilience.coord import Coordinator, CoordinatorTimeout
 from dexiraft_tpu.resilience.membership import (
@@ -83,6 +93,7 @@ from dexiraft_tpu.resilience.verify import (
 
 __all__ = [
     "CheckpointIntegrityError",
+    "CollectiveDivergence",
     "Coordinator",
     "CoordinatorTimeout",
     "ElasticConfig",

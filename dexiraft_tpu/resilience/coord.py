@@ -34,10 +34,15 @@ global, the watchdog bounds the wait when a peer can no longer vote.
 
 from __future__ import annotations
 
+import os
+import tempfile
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
+
+from dexiraft_tpu.analysis import collective_trace as _trace
+from dexiraft_tpu.analysis.collective_trace import CollectiveDivergence
 
 
 class CoordinatorTimeout(RuntimeError):
@@ -47,21 +52,27 @@ class CoordinatorTimeout(RuntimeError):
     operator (and the elastic membership runtime) sees WHICH peer of
     WHICH round went silent in one line. Under ``--elastic`` this is a
     reconfiguration trigger (resilience.membership); otherwise it is
-    fatal with an actionable message.
+    fatal with an actionable message. ``trace_path`` points at the
+    local collective flight-recorder dump (analysis.collective_trace)
+    written when the timeout fired: its tail names the round this host
+    died waiting in.
     """
 
     def __init__(self, namespace: str, round_id: int, peer: int,
-                 timeout_s: float):
+                 timeout_s: float, trace_path: Optional[str] = None):
         super().__init__(
             f"consensus timeout: peer {peer} posted no value for round "
             f"{round_id} of namespace '{namespace}' within "
             f"{timeout_s:.0f}s — the host is dead, stalled, or "
             f"partitioned (elastic runs reconfigure; others should "
-            f"restart the pod)")
+            f"restart the pod)"
+            + (f"; local collective trace: {trace_path}"
+               if trace_path else ""))
         self.namespace = namespace
         self.round_id = round_id
         self.peer = peer
         self.timeout_s = timeout_s
+        self.trace_path = trace_path
 
 
 def _is_deadline(exc: BaseException) -> bool:
@@ -104,7 +115,8 @@ class Coordinator:
                 thread_name_prefix=f"coord[{self.namespace}]")
         return self._pool
 
-    def _allgather(self, value: np.ndarray) -> np.ndarray:
+    def _allgather(self, value: np.ndarray,
+                   op: str = "exchange") -> np.ndarray:
         """(size, 1) array of every host's scalar.
 
         Rides the jax.distributed KV store (the coordination service
@@ -118,10 +130,24 @@ class Coordinator:
         because every consensus call is itself collective — the same
         discipline that makes the calls deadlock-free.
 
+        Lockstep is also VERIFIED, not just assumed: every round is
+        stamped into the collective flight recorder
+        (analysis.collective_trace) and the stamp (op + args digest)
+        piggybacks on the posted value — zero extra read RPCs — so a
+        peer whose round counter skewed (an identity branch, a
+        mid-protocol bail, a swallowed error) raises
+        CollectiveDivergence naming the first divergent (host, round,
+        op) the moment its mismatched key arrives, instead of pairing
+        mismatched rounds until a timeout.
+
         A dead peer leaves the blocking read waiting until timeout_s —
         the hang watchdog (armed around the step loop) bounds that wait
         long before the timeout does."""
+        tr = _trace.recorder()
+        rid = self._round
+        self._round += 1
         if self._allgather_fn is not None:
+            tr.record(self.namespace, op, round_id=rid)
             return np.asarray(self._allgather_fn(value))
         from jax._src import distributed
 
@@ -131,10 +157,21 @@ class Coordinator:
                 "multi-host consensus needs jax.distributed.initialize "
                 "(parallel.distributed.initialize) before the first "
                 "Coordinator call")
-        rid = self._round
-        self._round += 1
+        dig = _trace.args_digest(self.namespace, rid, op)
+        tr.record(self.namespace, op, round_id=rid, digest=dig)
         v = int(np.asarray(value).ravel()[0])
-        client.key_value_set(f"{self.namespace}/{rid}/{self.index}", str(v))
+        # publish the recorder tail BEFORE the value (peers diagnosing
+        # a wedge can read it even if this host dies before posting),
+        # then the value stamped with this round's op|digest.
+        # Diagnostics only: never fail the round for the recorder.
+        try:
+            client.key_value_set(
+                f"{self.namespace}/trace/{rid}/{self.index}",
+                tr.encode_tail())
+        except Exception:
+            pass
+        client.key_value_set(f"{self.namespace}/{rid}/{self.index}",
+                             f"{v}|{op}|{dig}")
         timeout_ms = max(1000, int(self.timeout_s * 1000))
 
         # concurrent peer reads: the sequential scan made a slow peer at
@@ -144,18 +181,31 @@ class Coordinator:
         # round. Index order is preserved in the gathered array.
         def read(i: int) -> int:
             try:
-                return int(client.blocking_key_value_get(
+                raw = str(client.blocking_key_value_get(
                     f"{self.namespace}/{rid}/{i}", timeout_ms))
             except Exception as e:
                 if _is_deadline(e):
-                    raise CoordinatorTimeout(self.namespace, rid, i,
-                                             self.timeout_s) from None
+                    raise CoordinatorTimeout(
+                        self.namespace, rid, i, self.timeout_s,
+                        trace_path=self._dump_trace()) from None
                 raise
+            parts = raw.split("|")
+            if len(parts) == 3 and i != self.index:
+                peer_op, peer_dig = parts[1], parts[2]
+                if (peer_op, peer_dig) != (op, dig):
+                    tr.note_divergence()
+                    self._dump_trace()
+                    raise CollectiveDivergence(
+                        self.namespace, rid, i,
+                        expected=f"{op}[{dig}]",
+                        seen=f"{peer_op}[{peer_dig}]")
+            return int(parts[0])
 
         if self.size <= 1:
             vals = [read(0)]
         else:
             vals = list(self._readers().map(read, range(self.size)))
+            tr.note_verified()
         # bounded KV footprint over multi-day runs: completing round
         # rid proves every host finished READING round rid-1 (the calls
         # are lockstep), so each host's own rid-1 key is globally
@@ -165,9 +215,23 @@ class Coordinator:
             try:
                 client.key_value_delete(
                     f"{self.namespace}/{rid - 1}/{self.index}")
+                client.key_value_delete(
+                    f"{self.namespace}/trace/{rid - 1}/{self.index}")
             except Exception:
                 pass
         return np.asarray(vals).reshape(self.size, 1)
+
+    def _dump_trace(self) -> str:
+        """Write the local flight-recorder ring next to the system tmp
+        dir; the CoordinatorTimeout message points here so a hung
+        consensus names the round it died in without a debugger."""
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f"dexiraft_collective_trace_h{self.index}.log")
+        try:
+            return _trace.recorder().dump(path)
+        except Exception:
+            return "<trace dump failed>"
 
     def warmup(self) -> None:
         """One throwaway exchange at startup: a misconfigured or
@@ -180,7 +244,8 @@ class Coordinator:
         """True iff ANY host raised the flag (identity single-process)."""
         if self.size == 1:
             return bool(flag)
-        return bool(self._allgather(np.asarray([bool(flag)])).any())
+        return bool(self._allgather(np.asarray([bool(flag)]),
+                                    op="any_flag").any())
 
     def min_int(self, value: int) -> int:
         """Min over hosts (identity single-process). Callers encode
@@ -190,7 +255,8 @@ class Coordinator:
         which the caller must treat as "no agreed target"."""
         if self.size == 1:
             return int(value)
-        return int(self._allgather(np.asarray([int(value)])).min())
+        return int(self._allgather(np.asarray([int(value)]),
+                                   op="min_int").min())
 
     def agree_step(self, restore_fn, step: Optional[int],
                    max_rounds: int = 4):

@@ -92,6 +92,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Set, Tuple
 
+from dexiraft_tpu.analysis import collective_trace
 from dexiraft_tpu.analysis.locks import OrderedLock
 from dexiraft_tpu.resilience.coord import Coordinator
 
@@ -378,6 +379,12 @@ class MembershipRuntime:
         print(f"[elastic] epoch {self.epoch} -> {new_epoch}: shrinking "
               f"{self.size} -> {len(plan)} members ({reason}); survivors "
               f"{plan}, new coordinator {new_addr}", flush=True)
+        # flight-recorder stamp BEFORE the teardown: every survivor
+        # records the same (epoch, plan) digest, so a host that agreed
+        # a different plan shows up as the first divergent op
+        collective_trace.record(
+            _ELASTIC_NS, "reconfigure", round_id=new_epoch,
+            digest=collective_trace.args_digest(new_epoch, tuple(plan)))
         self._teardown(graceful=False)
         info = self._install_epoch(new_epoch, new_addr, len(plan),
                                    new_rank, announce_joins={})
@@ -422,6 +429,12 @@ class MembershipRuntime:
               f"{self.size} -> {new_size} members (absorbing "
               f"{sorted(join_ranks)}), new coordinator {new_addr}",
               flush=True)
+        # same digest on every incumbent: the grow plan is rank 0's KV
+        # record verbatim, so a divergent absorption names itself
+        collective_trace.record(
+            _ELASTIC_NS, "absorb_joins", round_id=new_epoch,
+            digest=collective_trace.args_digest(
+                new_epoch, new_size, tuple(sorted(join_ranks))))
         self._teardown(graceful=True)
         info = self._install_epoch(new_epoch, new_addr, new_size,
                                    self.index, announce_joins=join_ranks)
@@ -557,6 +570,12 @@ class MembershipRuntime:
             self.board.announce_epoch(epoch, addr, size, announce_joins)
         elastic_initialize(addr, size, index, start_service=(index == 0),
                            init_timeout_s=self.config.init_timeout_s)
+        # every member of every epoch passes through here in lockstep:
+        # the (addr, size) digest is identical across the world, so a
+        # member installing a different world is the first divergence
+        collective_trace.record(
+            _ELASTIC_NS, "install_epoch", round_id=epoch,
+            digest=collective_trace.args_digest(addr, size))
         self.epoch = epoch
         self.size = size
         self.index = index

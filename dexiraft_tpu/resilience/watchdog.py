@@ -237,6 +237,16 @@ class HangWatchdog:
               f" (a host lost mid-collective? --elastic lets the "
               f"membership runtime shrink and continue instead)",
               file=out, flush=True)
+        # the collective flight-recorder tail FIRST: a stall inside a
+        # consensus/barrier names the namespace+round it died in (the
+        # faulthandler stacks below then show WHERE it is blocked)
+        try:
+            from dexiraft_tpu.analysis import collective_trace
+
+            print(collective_trace.recorder().render_tail(),
+                  file=out, flush=True)
+        except Exception:
+            pass
         try:
             faulthandler.dump_traceback(file=out)
             out.flush()

@@ -248,10 +248,15 @@ def _flush(key: str, step: int, host_state: Any, t0: float) -> float:
     not touched by the foreground while this runs (barrier discipline)."""
     hold = flush_hold
     if hold is not None:
-        hold.wait()
+        # test-only chaos hook: the test that set it owns the release;
+        # a timeout would end the staged zombie-flush scenario early
+        hold.wait()  # jaxlint: disable=JL032 chaos hook, test-released
     mgr = _MANAGERS[key]
     mgr.save(step, args=ocp.args.StandardSave(host_state))
-    mgr.wait_until_finished()
+    # orbax's API has no timeout parameter; its internal commit barrier
+    # is the only indefinite wait and multiprocess runs cap it via
+    # patch_orbax_kv_barriers
+    mgr.wait_until_finished()  # jaxlint: disable=JL032 no orbax timeout param
     return time.perf_counter() - t0
 
 
@@ -356,7 +361,12 @@ def wait_pending(directory: Optional[str] = None,
     exc: Optional[BaseException] = None
     flush_s = 0.0
     try:
-        flush_s = pending["future"].result()
+        # transitively bounded: the flush body's only indefinite wait is
+        # the orbax commit barrier (capped in multiprocess runs). An
+        # expiring result() would NOT cancel the flush — it would only
+        # let the foreground touch the manager mid-flush, breaking the
+        # barrier discipline this module is built on
+        flush_s = pending["future"].result()  # jaxlint: disable=JL032 barrier-bounded
     except Exception as e:  # orbax raises many types; the flush is lost
         exc = e
         error = f"{type(e).__name__}: {e}"

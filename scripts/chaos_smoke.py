@@ -91,7 +91,8 @@ import numpy as np  # noqa: E402
 # fire (the shrink phase's snapshot comes from the SURVIVOR CHILD —
 # the process that ran the lease thread + flush executor + watchdog
 # through a real reconfiguration)
-RECORD_KEYS = ("phases", "failures", "total_s", "locks", "lint_gate")
+RECORD_KEYS = ("phases", "failures", "total_s", "locks", "lint_gate",
+               "collective_trace")
 # every phase entry carries at least these keys ...
 PHASE_KEYS = ("ok", "wall_s")
 # ... and the concurrency-gate phases (kill-mid-flush,
@@ -305,6 +306,11 @@ def phase_kill_mid_flush(tmp: str) -> dict:
 # the elastic-membership story and belong in the same record
 _EXIT98_BASELINE: dict = {}
 
+# phase 8's survivor child publishes its collective flight-recorder
+# snapshot here (analysis/collective_trace); the record's top-level
+# collective_trace block folds it in next to the parent's own counters
+_SURVIVOR_TRACE: dict = {}
+
 
 def phase_multihost_kill(tmp: str) -> dict:
     repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
@@ -395,6 +401,16 @@ def phase_shrink_and_continue(tmp: str) -> dict:
     # executor + watchdog fabric through the reconfiguration
     assert surv["locks"]["order_violations"] == 0, surv["locks"]
     assert surv["locks"]["cycles"] == 0, surv["locks"]
+    # the child's collective flight recorder stamped every consensus
+    # round, membership epoch, and orbax barrier across the
+    # reconfiguration — lockstep must have verified clean (the in-band
+    # check compares every peer stamp while the world is > 1 host)
+    ct = surv.get("collective_trace") or {}
+    assert ct.get("divergences") == 0, \
+        f"survivor observed collective divergences: {ct}"
+    assert ct.get("entries", 0) > 0, \
+        f"flight recorder stamped nothing across the scenario: {ct}"
+    _SURVIVOR_TRACE.update(ct)
     baseline = _EXIT98_BASELINE.get("abort_s")
     if baseline is not None:
         assert recovery_s < baseline, \
@@ -406,7 +422,11 @@ def phase_shrink_and_continue(tmp: str) -> dict:
           f"{baseline}s; child locks clean")
     return {"recovery_s": round(recovery_s, 2),
             "exit98_abort_s": baseline,
-            "locks": dict(surv["locks"])}
+            "locks": dict(surv["locks"]),
+            "collective_trace": {
+                "entries": ct.get("entries"),
+                "verified_rounds": ct.get("verified_rounds"),
+                "divergences": ct.get("divergences")}}
 
 
 def phase_router_failover(tmp: str) -> dict:
@@ -538,7 +558,14 @@ def _lint_gate_verdict(failures: list) -> dict:
     verdict = {"ok": blob["ok"], "findings": len(blob["findings"]),
                "per_rule": {r: c["findings"]
                             for r, c in blob["per_rule"].items()
-                            if c["findings"]}}
+                            if c["findings"]},
+               # per-family breakdown (jaxlint/shardlint/threadlint/
+               # distlint): the record shows at a glance WHICH gate
+               # family a regression landed in
+               "per_family": {fam: {"rules": c["rules"],
+                                    "findings": c["findings"]}
+                              for fam, c in
+                              blob.get("per_family", {}).items()}}
     if not blob["ok"]:
         print(f"[chaos] lint gate FAIL: {verdict}", flush=True)
         failures.append("lint-gate")
@@ -598,9 +625,10 @@ def main() -> int:
     else:
         print(f"[chaos] all {len(phases)} recovery paths recovered "
               f"({total:.1f}s)")
-    from dexiraft_tpu.analysis import locks
+    from dexiraft_tpu.analysis import collective_trace, locks
 
     lrec = locks.stats_record()
+    trec = collective_trace.recorder()
     print("[chaos] record " + json.dumps(
         {"phases": record, "failures": failures,
          "total_s": round(total, 1),
@@ -609,6 +637,18 @@ def main() -> int:
          "locks": {"order_violations": lrec["order_violations"],
                    "cycles": lrec["cycles"],
                    "held_too_long": lrec["held_too_long"]},
+         # ... and its collective-lockstep verdict: the parent's own
+         # flight recorder plus the shrink survivor's (the process
+         # that ran real consensus rounds through a reconfiguration);
+         # divergences folds both — the pinned contract is 0
+         "collective_trace": {
+             "divergences": (trec.divergences
+                             + int(_SURVIVOR_TRACE.get("divergences")
+                                   or 0)),
+             "local_entries": trec.recorded,
+             "survivor_entries": _SURVIVOR_TRACE.get("entries"),
+             "survivor_verified_rounds":
+                 _SURVIVOR_TRACE.get("verified_rounds")},
          "lint_gate": gate_verdict},
         sort_keys=True), flush=True)
     return 1 if failures else 0

@@ -26,6 +26,12 @@ Usage:
                                               # stdout); exit code
                                               # semantics unchanged
   python scripts/lint_gate.py --list-rules
+  python scripts/lint_gate.py --rules JL03x   # run a rule subset (comma
+                                              # list; trailing x is a
+                                              # decade wildcard) — allow
+                                              # entries outside the
+                                              # subset are out of scope,
+                                              # not stale
   python scripts/lint_gate.py path/to/file.py # lint specific files
 
 Determinism config: dexiraft_tpu/analysis/baseline.json —
@@ -69,6 +75,12 @@ def main(argv=None) -> int:
                     help="print baseline.json 'allow' entries for every "
                          "current finding (review before pasting!)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (e.g. "
+                         "JL030,JL022); a trailing 'x' wildcards the "
+                         "decade (JL03x = every distlint rule). "
+                         "Baseline allow entries for unselected rules "
+                         "are out of scope, not stale")
     ap.add_argument("--stats", action="store_true",
                     help="print per-rule finding/allowlist counts after "
                          "the gate verdict")
@@ -84,9 +96,17 @@ def main(argv=None) -> int:
             print(f"{rule}  {name}")
         return 0
 
+    rules = _expand_rules(args.rules, jl.RULES) if args.rules else None
+
     baseline = None
     if not args.no_baseline:
         baseline = jl.Baseline.load(args.baseline)
+        if rules is not None:
+            # a rule-subset run judges staleness only WITHIN the subset:
+            # entries for unselected rules can't match (their rules never
+            # ran) and must not read as stale
+            baseline.allow = [e for e in baseline.allow
+                              if e.get("rule") in rules]
 
     if args.files:
         findings = []
@@ -96,7 +116,7 @@ def main(argv=None) -> int:
             if baseline is not None and baseline.excludes(rel):
                 n_excluded += 1
                 continue
-            findings.extend(jl.lint_file(osp.join(REPO, rel), rel))
+            findings.extend(jl.lint_file(osp.join(REPO, rel), rel, rules))
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
         if baseline is not None:
             kept, allowed, _ = baseline.split(findings)
@@ -106,7 +126,8 @@ def main(argv=None) -> int:
         stats = {"files": len(args.files) - n_excluded,
                  "excluded": n_excluded}
     else:
-        kept, allowed, stale, stats = jl.lint_tree(REPO, baseline=baseline)
+        kept, allowed, stale, stats = jl.lint_tree(REPO, baseline=baseline,
+                                                   rules=rules)
 
     if args.emit_allow:
         print(json.dumps([f.baseline_entry() for f in kept], indent=2))
@@ -135,6 +156,35 @@ def main(argv=None) -> int:
     if args.stats:
         _print_stats(jl, baseline, kept, allowed)
     return 0 if ok else 1
+
+
+def _expand_rules(spec: str, all_rules) -> set:
+    """--rules value -> concrete rule-id set. Tokens are exact ids or a
+    decade wildcard (trailing 'x': JL03x -> JL030..JL039); a token
+    matching no known rule is a usage error, not an empty run."""
+    sel = set()
+    for tok in spec.split(","):
+        tok = tok.strip().upper()
+        if not tok:
+            continue
+        if tok.endswith("X"):
+            hits = {r for r in all_rules if r.startswith(tok[:-1])}
+        else:
+            hits = {tok} if tok in all_rules else set()
+        if not hits:
+            raise SystemExit(
+                f"lint_gate: --rules token {tok!r} matches no known "
+                f"rule (see --list-rules)")
+        sel |= hits
+    return sel
+
+
+#: rule-id decade -> rule-family module (JL0dN: d selects the family)
+FAMILIES = {0: "jaxlint", 1: "shardlint", 2: "threadlint", 3: "distlint"}
+
+
+def _family(rule: str) -> str:
+    return FAMILIES.get(int(rule[2:]) // 10, "unknown")
 
 
 def _emit_json(jl, baseline, kept, allowed, stale, stats) -> int:
@@ -168,6 +218,17 @@ def _emit_json(jl, baseline, kept, allowed, stale, stats) -> int:
                    "baseline_entries": n_entries[rule]}
             for rule in sorted(jl.RULES)
             if n_kept[rule] or n_allowed[rule] or n_entries[rule]},
+        "per_family": {
+            fam: {
+                "rules": sum(1 for r in jl.RULES if _family(r) == fam),
+                "findings": sum(n_kept[r] for r in jl.RULES
+                                if _family(r) == fam),
+                "allowlisted": sum(n_allowed[r] for r in jl.RULES
+                                   if _family(r) == fam),
+                "baseline_entries": sum(n_entries[r] for r in jl.RULES
+                                        if _family(r) == fam),
+            }
+            for fam in sorted(set(_family(r) for r in jl.RULES))},
     }
     print(json.dumps(blob, indent=2, sort_keys=True))
     return 0 if ok else 1

@@ -215,6 +215,13 @@ def patch_orbax_kv_barriers(cap_timeout_s=None) -> None:
         timeout_s = timeout or 300
         if cap_timeout_s is not None:
             timeout_s = min(timeout_s, cap_timeout_s)
+        # flight-recorder stamp: the barrier key is the protocol
+        # identity (identical across hosts for a lockstep barrier)
+        from dexiraft_tpu.analysis import collective_trace
+
+        collective_trace.record(
+            "dexiraft/barrier", "orbax_sync",
+            digest=collective_trace.args_digest(str(name)))
         fn(key=name, timeout_ms=int(timeout_s * 1000))
 
     omh.sync_global_processes = kv_sync

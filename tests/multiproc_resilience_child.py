@@ -33,6 +33,12 @@ Fault injection:
   --die_step N --die_host K         host K os._exit(3)s after step N;
       the survivor must exit nonzero via watchdog/collective error,
       never hang.
+  --diverge_step N --diverge_host K host K issues an EXTRA collective
+      (a min_int round) at step N that its peer never runs — the
+      collective flight recorder's in-band lockstep check must raise
+      CollectiveDivergence naming the first divergent (host, round,
+      op) on both sides, in seconds, NOT a CoordinatorTimeout after
+      the full timeout window.
 
 Elastic mode (--elastic): the same faults, a different contract — the
 survivor CONTINUES instead of exiting. The child then runs the full
@@ -325,7 +331,7 @@ def run_elastic(args) -> None:
                        if n.isdigit())
     except OSError:
         saved = []
-    from dexiraft_tpu.analysis import locks
+    from dexiraft_tpu.analysis import collective_trace, locks
 
     lrec = locks.stats_record()
     result = {
@@ -336,6 +342,10 @@ def run_elastic(args) -> None:
         # verdict is what the chaos-smoke shrink phase pins
         "locks": {"order_violations": lrec["order_violations"],
                   "cycles": lrec["cycles"]},
+        # ... and every consensus round / membership epoch / orbax
+        # barrier through its flight recorder: the shrink scenario pins
+        # divergences == 0 across the reconfiguration
+        "collective_trace": collective_trace.recorder().snapshot(),
         "losses": losses,
         "slices": slices,
         "events": events,
@@ -366,6 +376,13 @@ def main() -> None:
     ap.add_argument("--poison_host", type=int, default=0)
     ap.add_argument("--die_step", type=int, default=None)
     ap.add_argument("--die_host", type=int, default=1)
+    ap.add_argument("--diverge_step", type=int, default=None,
+                    help="seeded lockstep divergence: at this step the "
+                         "diverge_host issues an extra min_int round "
+                         "its peer never runs — the flight recorder's "
+                         "in-band check must name the split, fast, "
+                         "instead of a CoordinatorTimeout")
+    ap.add_argument("--diverge_host", type=int, default=1)
     ap.add_argument("--stall_timeout", type=float, default=25.0)
     ap.add_argument("--elastic", action="store_true")
     ap.add_argument("--join", default=None,
@@ -379,6 +396,13 @@ def main() -> None:
                     help="elastic: at this save boundary, wait for a "
                          "join intent before the absorb check")
     args = ap.parse_args()
+
+    # the flight recorder carries THIS virtual host's id before the
+    # first collective (lazy install would default every child to host 0
+    # and the published stamps could not be attributed)
+    from dexiraft_tpu.analysis import collective_trace
+
+    collective_trace.install(host=args.process_id)
 
     if args.elastic or args.join:
         from dexiraft_tpu.resilience import ElasticFallback
@@ -461,6 +485,16 @@ def main() -> None:
                   flush=True)
             os._exit(3)
 
+        # seeded lockstep divergence: this host runs an EXTRA collective
+        # its peer never issues, splitting the round sequences — the
+        # stamp check must raise CollectiveDivergence naming this exact
+        # (round, op) on BOTH sides, well inside the coord timeout
+        if args.diverge_step is not None and step == args.diverge_step \
+                and pid == args.diverge_host:
+            print(f"[chaos] host {pid} diverging at step {step}: "
+                  f"extra min_int round", flush=True)
+            coord.min_int(0)
+
         # host-LOCAL poison verdict -> collective decision
         poisoned_here = (args.poison_step is not None
                          and step == args.poison_step
@@ -490,10 +524,13 @@ def main() -> None:
     norm = float(np.sqrt(sum(
         float(np.sum(np.asarray(x) ** 2))
         for x in jax.tree.leaves(jax.device_get(state.params)))))
+    from dexiraft_tpu.analysis import collective_trace
+
     result = {
         "process_id": pid,
         "losses": losses,
         "events": events,
+        "collective_trace": collective_trace.recorder().snapshot(),
         "param_norm": norm,
         "final_w": np.asarray(jax.device_get(state.params["w"])).tolist(),
         "saved_steps": ckpt.all_steps(args.ckpt_dir),

@@ -3,7 +3,7 @@ consensus + watchdog + async-checkpoint story single-process tests
 cannot cover (named test_zz* to sort after the seed suite per the
 tier-1 budget convention).
 
-Five scenarios against tests/multiproc_resilience_child.py (which runs
+Six scenarios against tests/multiproc_resilience_child.py (which runs
 the same resilience primitives train_cli wires — coord, watchdog,
 async checkpoint, verified agreed restore, elastic membership):
 
@@ -16,6 +16,11 @@ async checkpoint, verified agreed restore, elastic membership):
   * coordinated resume: after the kill, a --resume pair agrees on one
     restored step and finishes with parameters BIT-EXACT equal to an
     uninterrupted reference run.
+  * seeded divergence: one host issues an extra collective round its
+    peer never runs; the collective flight recorder's in-band lockstep
+    check (analysis/collective_trace via resilience/coord) must name
+    the first divergent (host, round, op) in seconds — never a
+    CoordinatorTimeout after the full window.
   * elastic shrink-and-continue: the same kill under --elastic, but the
     survivor CONTINUES — missed lease -> new membership epoch, solo
     mesh re-form, agreed-step restore, re-sliced data — and its
@@ -135,6 +140,37 @@ def test_resume_after_kill_is_bit_exact(kill_and_reference, tmp_path):
     assert results[0]["final_w"] == results[1]["final_w"]
 
 
+def test_seeded_divergence_is_named_not_timed_out(tmp_path):
+    """Host 1 issues an EXTRA min_int round at step 3 (--diverge_step)
+    that host 0 never runs — the canonical lockstep bug distlint JL030/
+    JL031 exists to prevent. The collective flight recorder's in-band
+    stamp check must diagnose it: BOTH hosts raise CollectiveDivergence
+    naming the first divergent (host, round, op) within seconds, NOT a
+    CoordinatorTimeout after the full 60 s coord window."""
+    outs = [tmp_path / f"d{i}.json" for i in range(2)]
+    rcs, logs, wall = _spawn_pair(
+        outs, tmp_path / "ck",
+        extra=["--num_steps", "4", "--save_every", "2",
+               "--diverge_step", "3", "--diverge_host", "1",
+               "--coord_timeout", "60", "--stall_timeout", "120"],
+        timeout=180.0)
+    # both sides die via the hard-exit guard with the divergence raised
+    assert rcs == [97, 97], f"rcs {rcs}:\n{logs[0][-2000:]}\n" \
+                            f"{logs[1][-2000:]}"
+    # diagnosed in seconds — NOT by pairing mismatched rounds until the
+    # 60 s coord timeout (or the 120 s watchdog) expired
+    assert wall < 45, f"divergence took {wall:.0f}s to surface — the " \
+        f"in-band check did not fire before the timeout window"
+    for i, log in enumerate(logs):
+        assert "collective divergence" in log, (i, log[-2000:])
+        assert "CoordinatorTimeout" not in log, (i, log[-2000:])
+    # ... and NAMED: each side reports the peer host, the round, and
+    # the expected-vs-seen ops of the first divergent call
+    assert "host 1 issued 'min_int" in logs[0], logs[0][-2000:]
+    assert "round 3" in logs[0] and "any_flag" in logs[0]
+    assert "host 0 issued 'any_flag" in logs[1], logs[1][-2000:]
+
+
 def test_elastic_shrink_and_continue(tmp_path):
     """Host 1 dies at step 3 under --elastic: host 0 must detect the
     missed lease, reconfigure into a solo epoch-1 world (smaller mesh,
@@ -165,6 +201,13 @@ def test_elastic_shrink_and_continue(tmp_path):
     # post-shrink the solo member owns every sample of each window
     assert surv["slices"]["8"]["size"] == 1
     assert len(surv["slices"]["8"]["ids"]) == 8
+    # the collective flight recorder ran through the whole scenario —
+    # pair consensus, the shrink reconfiguration, the solo epoch — and
+    # lockstep verified CLEAN: a reconfiguration is exactly the kind of
+    # protocol whose rounds could silently skew
+    ct = surv["collective_trace"]
+    assert ct["divergences"] == 0, ct
+    assert ct["entries"] > 0 and ct["host"] == 0, ct
 
     # parity pin: a FRESH solo elastic run restoring the same agreed
     # step from the same directory (replicated pair checkpoint landing

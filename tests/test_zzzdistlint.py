@@ -1,0 +1,633 @@
+"""distlint (JL030+) + collective flight recorder coverage.
+
+One positive + one negative fixture per collective-divergence rule
+(incl. inline suppression, the matching-branches exemption, and
+JL032's distributed-path scoping), the CollectiveTrace ring/digest/
+counter semantics on a fake clock, the pure lockstep verifier naming
+the first divergent op on scripted traces, the snapshot/record schema
+pins, and the lint_gate --rules filter + per_family --json contract.
+
+Named zzz to sort LAST (tier-1 budget convention); everything here is
+pure-stdlib AST fixtures + in-process recorder plumbing — target well
+under 5 s. The 2-process seeded-divergence leg (a REAL pair diagnosing
+a real skew) lives in tests/test_zzmultihost_resilience.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os.path as osp
+import subprocess
+import sys
+import textwrap
+
+from dexiraft_tpu.analysis import collective_trace as ct
+from dexiraft_tpu.analysis import jaxlint, locks, threadlint
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+GATE = osp.join(REPO, "scripts", "lint_gate.py")
+
+#: JL032 is path-scoped to the distributed tier; the other rules run
+#: everywhere, so fixtures default to a neutral path
+DIST_PATH = "dexiraft_tpu/resilience/fixture.py"
+
+
+def rules_of(src: str, path: str = "dexiraft_tpu/serve/fixture.py"):
+    return {f.rule for f in jaxlint.lint_source(textwrap.dedent(src), path)}
+
+
+# --------------------------------------------------------------------------
+# static rules: one positive + one negative fixture per rule
+# --------------------------------------------------------------------------
+
+
+class TestRuleFixtures:
+    def test_jl030_divergent_collective_branch(self):
+        pos = """
+            import jax
+
+            def broadcast(coord, flag):
+                if jax.process_index() == 0:
+                    return coord.any_flag(flag)
+                return flag
+        """
+        assert "JL030" in rules_of(pos)
+        # matching-branches exemption: both arms run the SAME collective
+        # sequence (different args, same protocol) — lockstep holds
+        neg = """
+            import jax
+
+            def broadcast(coord, flag):
+                if jax.process_index() == 0:
+                    return coord.any_flag(flag)
+                else:
+                    return coord.any_flag(False)
+        """
+        assert "JL030" not in rules_of(neg)
+
+    def test_jl030_needs_identity_test_and_collective(self):
+        # branch on replicated state (a count) is lockstep: clean
+        neg = """
+            def maybe(coord, n, flag):
+                if n > 1:
+                    return coord.any_flag(flag)
+                return flag
+        """
+        assert "JL030" not in rules_of(neg)
+        # identity branch with only local work (KV posts, prints): clean
+        neg2 = """
+            def leader_log(self, msg):
+                if self.index == 0:
+                    print(msg)
+        """
+        assert "JL030" not in rules_of(neg2)
+
+    def test_jl031_mid_protocol_bail(self):
+        pos = """
+            def protocol(coord, ok):
+                seen = coord.any_flag(False)
+                if not ok:
+                    return None
+                return seen, coord.min_int(3)
+        """
+        assert "JL031" in rules_of(pos)
+        # bail governed by a collective verdict: every host bails
+        # together — the sanctioned shape
+        neg = """
+            def protocol(coord, ok):
+                seen = coord.any_flag(False)
+                if coord.any_flag(not ok):
+                    return None
+                return seen, coord.min_int(3)
+        """
+        assert "JL031" not in rules_of(neg)
+        # ... including via a verdict NAME assigned from a collective
+        neg2 = """
+            def protocol(coord, ok):
+                stop = coord.any_flag(not ok)
+                if stop:
+                    return None
+                return coord.min_int(3)
+        """
+        assert "JL031" not in rules_of(neg2)
+
+    def test_jl031_loop_continue_and_exemptions(self):
+        pos = """
+            def train(coord, steps):
+                for step in steps:
+                    if step.skip_locally:
+                        continue
+                    coord.any_flag(step.bad)
+        """
+        assert "JL031" in rules_of(pos)
+        # break stays inside the function, before the next round — and a
+        # raise inside an except handler is failing loudly AFTER a
+        # broken round, not a divergence
+        neg = """
+            def train(coord, steps):
+                for step in steps:
+                    if step.done:
+                        break
+                    try:
+                        coord.any_flag(step.bad)
+                    except RuntimeError as e:
+                        raise ValueError(str(e))
+        """
+        assert "JL031" not in rules_of(neg)
+        # a single-collective function is not a protocol: bail freely
+        neg2 = """
+            def once(coord, ok):
+                if not ok:
+                    return None
+                return coord.any_flag(True)
+        """
+        assert "JL031" not in rules_of(neg2)
+
+    def test_jl032_unbounded_distributed_wait(self):
+        pos = """
+            def drain(fut):
+                return fut.result()
+        """
+        assert "JL032" in rules_of(pos, DIST_PATH)
+        # timeout=None is the spelled-out unbounded form: still flagged
+        pos2 = """
+            def drain(ev):
+                ev.wait(timeout=None)
+        """
+        assert "JL032" in rules_of(pos2, DIST_PATH)
+        # keyword or positional timeout bounds the wait: clean
+        neg = """
+            def drain(fut, ev, t):
+                fut.result(timeout=5.0)
+                ev.wait(2.0)
+                t.join(timeout=1.0)
+        """
+        assert "JL032" not in rules_of(neg, DIST_PATH)
+
+    def test_jl032_is_path_scoped(self):
+        # the same unbounded wait OUTSIDE the distributed tier keeps its
+        # idiom (single-process queue plumbing has no dead peers)
+        src = """
+            def drain(fut):
+                return fut.result()
+        """
+        assert "JL032" not in rules_of(src)  # serve/ fixture path
+        assert "JL032" in rules_of(
+            src, "dexiraft_tpu/parallel/distributed.py")
+
+    def test_jl033_swallowed_collective_error(self):
+        pos = """
+            def vote(coord, flag):
+                try:
+                    return coord.any_flag(flag)
+                except Exception:
+                    return False
+        """
+        assert "JL033" in rules_of(pos)
+        # re-raising (bare or wrapped) keeps the divergence loud: clean
+        neg = """
+            def vote(coord, flag):
+                try:
+                    return coord.any_flag(flag)
+                except Exception as e:
+                    raise RuntimeError("vote failed") from e
+        """
+        assert "JL033" not in rules_of(neg)
+        # a try with no collective inside carries no round counter
+        neg2 = """
+            def local(io):
+                try:
+                    return io.read()
+                except Exception:
+                    return None
+        """
+        assert "JL033" not in rules_of(neg2)
+
+    def test_jl034_unreleased_armed_region(self):
+        pos = """
+            def step(wd, fn):
+                wd.arm(1)
+                out = fn()
+                wd.disarm()
+                return out
+        """
+        assert "JL034" in rules_of(pos)
+        # the sanctioned idiom: arm OUTSIDE the try, release in finally
+        neg = """
+            def step(wd, fn):
+                wd.arm(1)
+                try:
+                    return fn()
+                finally:
+                    wd.stop()
+        """
+        assert "JL034" not in rules_of(neg)
+
+    def test_jl034_receiver_must_match(self):
+        # releasing a DIFFERENT receiver does not discharge the arm
+        pos = """
+            def step(wd, other, fn):
+                wd.arm(1)
+                try:
+                    return fn()
+                finally:
+                    other.stop()
+        """
+        assert "JL034" in rules_of(pos)
+        # dotted receivers match on their full spelling
+        neg = """
+            def step(self, fn):
+                self.wd.arm(1)
+                try:
+                    return fn()
+                finally:
+                    self.wd.disarm()
+        """
+        assert "JL034" not in rules_of(neg)
+
+    def test_jl034_sanctioned_window(self):
+        pos = """
+            def reshape(watch, fn):
+                watch.sanctioned()
+                return fn()
+        """
+        assert "JL034" in rules_of(pos)
+        neg = """
+            def reshape(watch, fn):
+                with watch.sanctioned():
+                    return fn()
+        """
+        assert "JL034" not in rules_of(neg)
+        # assigned to a name later entered by `with` (the conditional-
+        # window idiom) also counts as scoped
+        neg2 = """
+            from contextlib import nullcontext
+
+            def reshape(watch, fn, fresh):
+                win = watch.sanctioned() if fresh else nullcontext()
+                with win:
+                    return fn()
+        """
+        assert "JL034" not in rules_of(neg2)
+
+    def test_inline_suppression(self):
+        src = """
+            def protocol(coord, ok):
+                seen = coord.any_flag(False)
+                if not ok:
+                    return None  # jaxlint: disable=JL031 test-owned bail
+                return seen, coord.min_int(3)
+        """
+        assert "JL031" not in rules_of(src)
+
+
+# --------------------------------------------------------------------------
+# the gate trips on every injected-footgun fixture (one invocation),
+# and --rules/--json per_family report it machine-readably
+# --------------------------------------------------------------------------
+
+
+_FOOTGUNS = {
+    "JL030": """
+        import jax
+
+        def broadcast(coord, flag):
+            if jax.process_index() == 0:
+                return coord.any_flag(flag)
+            return flag
+    """,
+    "JL031": """
+        def protocol(coord, ok):
+            seen = coord.any_flag(False)
+            if not ok:
+                return None
+            return seen, coord.min_int(3)
+    """,
+    "JL032": """
+        def drain(fut):
+            return fut.result()
+    """,
+    "JL033": """
+        def vote(coord, flag):
+            try:
+                return coord.any_flag(flag)
+            except Exception:
+                return False
+    """,
+    "JL034": """
+        def step(wd, fn):
+            wd.arm(1)
+            out = fn()
+            wd.disarm()
+            return out
+    """,
+}
+
+
+def _write_fixtures(tmp_path):
+    """Fixture files, repo-relative. JL032's lives under a
+    dexiraft_tpu/resilience/ subtree so its path marker matches."""
+    rels = []
+    for rule, src in _FOOTGUNS.items():
+        if rule == "JL032":
+            d = tmp_path / "dexiraft_tpu" / "resilience"
+            d.mkdir(parents=True, exist_ok=True)
+            p = d / "fixture_jl032.py"
+        else:
+            p = tmp_path / f"fixture_{rule.lower()}.py"
+        p.write_text(textwrap.dedent(src))
+        rels.append(osp.relpath(str(p), REPO))
+    return rels
+
+
+def test_gate_trips_on_each_rule_fixture(tmp_path):
+    """Acceptance pin: lint_gate exits nonzero on every JL03x footgun
+    (all five fixtures in ONE gate run to stay inside the test budget),
+    and --json reports the same verdict machine-readably."""
+    rels = _write_fixtures(tmp_path)
+    r = subprocess.run([sys.executable, GATE, "--json", *rels], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    blob = json.loads(r.stdout)
+    assert blob["ok"] is False
+    fired = {f["rule"] for f in blob["findings"]}
+    assert set(_FOOTGUNS) <= fired, (set(_FOOTGUNS) - fired, blob)
+    for rule in _FOOTGUNS:
+        assert blob["per_rule"][rule]["findings"] >= 1
+    # the per-family breakdown attributes every hit to distlint
+    assert blob["per_family"]["distlint"]["findings"] >= 5
+    assert blob["per_family"]["distlint"]["rules"] == 5
+    assert set(blob["per_family"]) == {"jaxlint", "shardlint",
+                                       "threadlint", "distlint"}
+
+
+def test_gate_rules_filter_selects_families(tmp_path):
+    """--rules JL03x runs ONLY distlint: a file carrying both a JL021
+    (threadlint) and a JL031 (distlint) footgun fires just the
+    latter."""
+    both = tmp_path / "fixture_both.py"
+    both.write_text(textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def a(self):
+                with self._lock:
+                    self.n += 1
+
+            def b(self):
+                self.n += 1
+
+        def protocol(coord, ok):
+            seen = coord.any_flag(False)
+            if not ok:
+                return None
+            return seen, coord.min_int(3)
+    """))
+    rel = osp.relpath(str(both), REPO)
+    r = subprocess.run(
+        [sys.executable, GATE, "--rules", "JL03x", "--json", rel],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    blob = json.loads(r.stdout)
+    fired = {f["rule"] for f in blob["findings"]}
+    assert "JL031" in fired and "JL021" not in fired, fired
+    # an unknown token is a usage error, not a silent empty run
+    r2 = subprocess.run(
+        [sys.executable, GATE, "--rules", "JL099", rel],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r2.returncode != 0
+    assert "matches no known rule" in (r2.stdout + r2.stderr)
+
+
+def test_gate_rules_subset_tree_run_is_clean():
+    """`--rules JL03x` over the real tree: zero findings, AND the
+    baseline's jaxlint allow entries must read as out-of-scope, not
+    stale (the subset filter owns staleness semantics)."""
+    r = subprocess.run(
+        [sys.executable, GATE, "--rules", "JL03x", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-1000:]
+    blob = json.loads(r.stdout)
+    assert blob["ok"] is True
+    assert blob["findings"] == []
+    assert blob["stale_allow"] == []
+    assert blob["per_family"]["distlint"] == {
+        "rules": 5, "findings": 0, "allowlisted": 0,
+        "baseline_entries": 0}
+
+
+def test_stale_distlint_baseline_entry_fails_gate(tmp_path):
+    """Stale-entry detection covers distlint: an allow entry for a
+    JL03x finding that no longer exists must fail the gate with the
+    entry named (excuses die with the code they excused)."""
+    base = json.load(open(osp.join(REPO, "dexiraft_tpu", "analysis",
+                                   "baseline.json")))
+    base["allow"].append({
+        "rule": "JL031", "path": "dexiraft_tpu/resilience/coord.py",
+        "snippet": "return None  # long-gone bail", "reason": "test"})
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(base))
+    r = subprocess.run(
+        [sys.executable, GATE, "--json", "--baseline", str(p)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout[-2000:]
+    blob = json.loads(r.stdout)
+    assert blob["ok"] is False
+    assert any(e.get("rule") == "JL031" for e in blob["stale_allow"]), \
+        blob["stale_allow"]
+    assert blob["findings"] == []  # ONLY the stale entry failed it
+
+
+# --------------------------------------------------------------------------
+# CollectiveTrace: ring / digest / counter semantics on a fake clock
+# --------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCollectiveTrace:
+    def test_ring_bounds_memory_counters_keep_totals(self):
+        tr = ct.CollectiveTrace(host=1, capacity=4, clock=FakeClock())
+        for i in range(7):
+            tr.record("ns", "op", round_id=i)
+        assert tr.recorded == 7
+        kept = tr.tail(10)
+        assert len(kept) == 4  # ring evicted the oldest three
+        assert [e[1] for e in kept] == [3, 4, 5, 6]
+
+    def test_auto_round_counters_are_per_namespace(self):
+        tr = ct.CollectiveTrace(clock=FakeClock())
+        a0 = tr.record("a", "x")
+        b0 = tr.record("b", "y")
+        a1 = tr.record("a", "x")
+        assert (a0[1], b0[1], a1[1]) == (0, 0, 1)
+
+    def test_args_digest_stable_and_discriminating(self):
+        d1 = ct.args_digest("ns", 3, "any_flag")
+        assert d1 == ct.args_digest("ns", 3, "any_flag")
+        assert len(d1) == 8 and int(d1, 16) >= 0
+        assert d1 != ct.args_digest("ns", 3, "min_int")
+        assert d1 != ct.args_digest("ns", 4, "any_flag")
+
+    def test_default_digest_derived_from_identity(self):
+        tr = ct.CollectiveTrace(clock=FakeClock())
+        ns, rid, op, dig = tr.record("ns", "op", round_id=5)
+        assert dig == ct.args_digest("ns", "op", 5)
+
+    def test_snapshot_schema_pin(self):
+        tr = ct.CollectiveTrace(host=2, clock=FakeClock())
+        for i in range(12):
+            tr.record("ns", "op", round_id=i)
+        tr.note_verified(3)
+        snap = tr.snapshot()
+        assert set(snap) == {"host", "entries", "verified_rounds",
+                             "divergences", "last"}
+        assert snap["host"] == 2
+        assert snap["entries"] == 12
+        assert snap["verified_rounds"] == 3
+        assert snap["divergences"] == 0
+        assert len(snap["last"]) == 8  # bounded result-JSON footprint
+        assert all(len(e) == 4 for e in snap["last"])
+        json.dumps(snap)  # result-JSON-safe by construction
+
+    def test_encode_decode_round_trip(self):
+        tr = ct.CollectiveTrace(clock=FakeClock())
+        tr.record("dexiraft/coord", "any_flag", round_id=0)
+        tr.record("dexiraft/barrier", "orbax_sync", round_id=1)
+        rows = ct.decode_trace(tr.encode_tail())
+        assert rows == [tuple(e[:4]) for e in tr.tail()]
+        assert ct.decode_trace("") == []
+
+    def test_render_and_dump_name_the_rounds(self, tmp_path):
+        clock = FakeClock()
+        clock.t = 1.5
+        tr = ct.CollectiveTrace(host=1, clock=clock)
+        tr.record("dexiraft/coord", "min_int", round_id=7)
+        text = tr.render_tail()
+        assert "dexiraft/coord/7: min_int" in text
+        assert "host 1" in text and "t=1.500" in text
+        path = tr.dump(str(tmp_path / "trace.log"))
+        assert "min_int" in open(path).read()
+
+    def test_module_recorder_install_and_lazy(self):
+        saved = ct._RECORDER
+        try:
+            tr = ct.install(host=3, clock=FakeClock())
+            assert ct.recorder() is tr
+            ct.record("ns", "op")
+            assert tr.recorded == 1 and tr.host == 3
+            ct._RECORDER = None
+            assert ct.recorder().host == 0  # lazy default: always on
+        finally:
+            ct._RECORDER = saved
+
+    def test_trace_ring_lock_is_registered_leaf(self):
+        assert "resilience.trace.ring" in locks.LOCK_ORDER
+        # and the threadlint static mirror carries it too (the
+        # LOCK_ORDER mirror pin keeps them equal; this pins presence)
+        assert "resilience.trace.ring" in threadlint.LOCK_ORDER
+        assert locks.LOCK_ORDER[-1] == "resilience.trace.ring"
+
+
+# --------------------------------------------------------------------------
+# the lockstep verifier (pure, scripted traces)
+# --------------------------------------------------------------------------
+
+
+def _trace(*ops, ns="c"):
+    return [(ns, i, op, ct.args_digest(ns, i, op))
+            for i, op in enumerate(ops)]
+
+
+class TestVerifyLockstep:
+    def test_identical_traces_are_clean(self):
+        t = _trace("any_flag", "min_int", "any_flag")
+        v = ct.verify_lockstep({0: t, 1: list(t), 2: list(t)})
+        assert v["ok"] is True
+        assert v["first_divergence"] is None
+        assert v["hosts"] == 3 and v["compared"] == 6
+
+    def test_seeded_divergence_names_first_divergent_op(self):
+        ref = _trace("any_flag", "min_int", "any_flag", "min_int")
+        skew = _trace("any_flag", "min_int", "min_int", "any_flag")
+        v = ct.verify_lockstep({0: ref, 1: skew})
+        assert v["ok"] is False
+        d = v["first_divergence"]
+        assert d["host"] == 1 and d["index"] == 2 and d["round"] == 2
+        assert d["expected"].startswith("c/2:any_flag[")
+        assert d["seen"].startswith("c/2:min_int[")
+
+    def test_length_skew_is_not_a_divergence(self):
+        ref = _trace("any_flag", "min_int", "any_flag")
+        short = ref[:1]  # ring capacity / publish cadence skew
+        v = ct.verify_lockstep({0: ref, 1: short})
+        assert v["ok"] is True and v["compared"] == 1
+
+    def test_earliest_divergence_wins_across_peers(self):
+        ref = _trace("a_op", "b_op", "c_op")
+
+        def mutate(rows, i, op):
+            rows = list(rows)
+            ns, rid, _, _ = rows[i]
+            rows[i] = (ns, rid, op, ct.args_digest(ns, rid, op))
+            return rows
+
+        traces = {0: ref,
+                  1: mutate(ref, 2, "late_op"),
+                  2: mutate(ref, 1, "early_op")}
+        d = ct.verify_lockstep(traces)["first_divergence"]
+        assert (d["host"], d["index"]) == (2, 1)
+
+    def test_trailing_fields_ignored_and_empty_ok(self):
+        ref = [r + (1.25,) for r in _trace("any_flag")]  # timestamps
+        assert ct.verify_lockstep({0: ref, 1: _trace("any_flag")})["ok"]
+        assert ct.verify_lockstep({})["ok"] is True
+
+    def test_divergence_exception_names_the_split(self):
+        e = ct.CollectiveDivergence("dexiraft/coord", 3, 1,
+                                    expected="any_flag[aa]",
+                                    seen="min_int[bb]")
+        msg = str(e)
+        assert "round 3" in msg and "host 1" in msg
+        assert "any_flag[aa]" in msg and "min_int[bb]" in msg
+        assert isinstance(e, RuntimeError)
+        assert (e.namespace, e.round_id, e.host) == ("dexiraft/coord",
+                                                     3, 1)
+
+
+# --------------------------------------------------------------------------
+# schema pins shared with the chaos smoke
+# --------------------------------------------------------------------------
+
+
+def test_chaos_record_pins_collective_trace_block():
+    sys.path.insert(0, osp.join(REPO, "scripts"))
+    try:
+        import chaos_smoke
+    finally:
+        sys.path.pop(0)
+    assert "collective_trace" in chaos_smoke.RECORD_KEYS
+    assert set(chaos_smoke.RECORD_KEYS) >= {
+        "phases", "failures", "total_s", "locks", "lint_gate",
+        "collective_trace"}
+
+
+def test_coordinator_timeout_references_trace_dump():
+    from dexiraft_tpu.resilience.coord import CoordinatorTimeout
+
+    e = CoordinatorTimeout("ns", 4, 1, 6.0, trace_path="/tmp/t.log")
+    assert "local collective trace: /tmp/t.log" in str(e)
+    assert e.trace_path == "/tmp/t.log"
+    # without a dump the message stays clean
+    assert "collective trace" not in str(
+        CoordinatorTimeout("ns", 4, 1, 6.0))
